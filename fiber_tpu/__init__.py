@@ -1,0 +1,68 @@
+"""fiber_tpu — a TPU-native distributed computing framework.
+
+fiber_tpu re-creates the capabilities of uber/fiber (a Python
+``multiprocessing``-compatible API over cluster schedulers — reference
+surveyed in SURVEY.md) as a brand-new framework whose first-class target is
+a Cloud TPU pod slice:
+
+* The **host plane** — ``Process``, ``Pool``, ``SimpleQueue``, ``Pipe``,
+  ``Manager`` — runs arbitrary Python task-parallel workloads across
+  TPU-VM hosts (or local subprocesses) over a framed-TCP transport
+  (reference parity: fiber/context.py, fiber/pool.py, fiber/queues.py).
+* The **device plane** — ``fiber_tpu.parallel`` / ``fiber_tpu.ops`` —
+  lowers ``Pool.map`` of jittable functions to a ``shard_map``
+  scatter → XLA-compiled worker → gather over a ``jax.sharding.Mesh``,
+  and lowers ``Ring`` allreduce to ``jax.lax.psum`` over ICI.
+
+Public API parity with the reference package root (fiber/__init__.py:65-68
+hoists the context attributes; we do the same explicitly).
+"""
+
+import os as _os
+
+__version__ = "0.1.0"
+
+from fiber_tpu import config  # noqa: F401
+from fiber_tpu.meta import meta  # noqa: F401
+from fiber_tpu.context import FiberContext as _FiberContext
+
+_default_context = _FiberContext()
+
+# Hoisted context API (reference: fiber/__init__.py:65-68).
+Process = _default_context.Process
+Pool = _default_context.Pool
+Manager = _default_context.Manager
+AsyncManager = _default_context.AsyncManager
+SimpleQueue = _default_context.SimpleQueue
+Pipe = _default_context.Pipe
+cpu_count = _default_context.cpu_count
+current_process = _default_context.current_process
+active_children = _default_context.active_children
+get_context = _default_context.get_context
+
+in_worker = _os.environ.get("FIBER_WORKER", "") not in ("", "0")
+
+
+def init(**kwargs):
+    """(Re)initialize fiber_tpu: apply config overrides and reset logging.
+
+    Reference parity: fiber/__init__.py:54-62 + fiber/init.py:52-73.
+    """
+    from fiber_tpu.utils import logging as _fl
+
+    config.init(**kwargs)
+    _fl.init_logger(config.get())
+
+
+def reset():
+    """Reset config back to defaults (then env/file reapply on next init)."""
+    config.reset()
+
+
+# Master-process logger init at import, mirroring fiber/__init__.py:36-41:
+# workers re-init inside the spawn bootstrap with the shipped config instead.
+if not in_worker:
+    from fiber_tpu.utils import logging as _fl
+
+    _fl.init_logger(config.get())
+del _os

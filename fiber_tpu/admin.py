@@ -1,0 +1,159 @@
+"""The admin control plane: how a master meets the workers it launches.
+
+One ``AdminServer`` per master process runs a single accept-loop thread
+(reference parity: the fiber background thread,
+fiber/popen_fiber_spawn.py:97-139). A newly-launched worker's first act is
+to dial this server and send its 8-byte launch ident; the server hands the
+connected socket to the launcher that is blocked waiting for that ident.
+The same socket then carries the pickled process state to the worker and
+afterwards serves as the liveness sentinel in both directions (master polls
+it; the worker's watchdog dies when it closes).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+from fiber_tpu.utils.logging import get_logger
+from fiber_tpu.utils.net import random_port_bind
+
+logger = get_logger()
+
+_IDENT = struct.Struct(">Q")
+
+
+class Waiter:
+    """A pending worker connect-back slot."""
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self.conn: Optional[socket.socket] = None
+
+    def wait(self, timeout: Optional[float]) -> Optional[socket.socket]:
+        if self._event.wait(timeout):
+            return self.conn
+        return None
+
+    def fire(self, conn: socket.socket) -> None:
+        self.conn = conn
+        self._event.set()
+
+
+class AdminServer:
+    """Accept-loop singleton. Exactly one per master process regardless of
+    how many processes are started concurrently (reference contract tested
+    by tests/test_popen.py:70-94)."""
+
+    _instance: Optional["AdminServer"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, ip: str, port: int) -> None:
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if port:
+            self._listener.bind(("", port))
+            self.port = port
+        else:
+            _, self.port = random_port_bind(self._listener)
+        self.ip = ip
+        self._listener.listen(256)
+        self._waiters: Dict[int, Waiter] = {}
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="fiber-admin", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def ensure(cls, ip: str, port: int = 0) -> "AdminServer":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls(ip, port)
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        """Tear down the singleton (tests only)."""
+        with cls._instance_lock:
+            inst, cls._instance = cls._instance, None
+        if inst is not None:
+            try:
+                inst._listener.close()
+            except OSError:
+                pass
+
+    @classmethod
+    def instance(cls) -> Optional["AdminServer"]:
+        return cls._instance
+
+    # ------------------------------------------------------------------
+    def address(self) -> Tuple[str, int]:
+        return (self.ip, self.port)
+
+    def expect(self, ident: int) -> Waiter:
+        waiter = Waiter()
+        with self._lock:
+            self._waiters[ident] = waiter
+        return waiter
+
+    def cancel(self, ident: int) -> None:
+        with self._lock:
+            self._waiters.pop(ident, None)
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, addr = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            threading.Thread(
+                target=self._handshake,
+                args=(conn, addr),
+                name="fiber-admin-handshake",
+                daemon=True,
+            ).start()
+
+    def _handshake(self, conn: socket.socket, addr) -> None:
+        """Read the worker's ident off a fresh connection and route it.
+        Runs in its own short-lived thread so one slow/buggy dialer cannot
+        stall every other launch."""
+        try:
+            conn.settimeout(30.0)
+            data = b""
+            while len(data) < _IDENT.size:
+                chunk = conn.recv(_IDENT.size - len(data))
+                if not chunk:
+                    raise OSError("closed during ident handshake")
+                data += chunk
+            (ident,) = _IDENT.unpack(data)
+            conn.settimeout(None)
+        except OSError:
+            conn.close()
+            return
+        with self._lock:
+            waiter = self._waiters.pop(ident, None)
+        if waiter is None:
+            logger.warning("admin: unexpected connect-back ident=%s from %s",
+                           ident, addr)
+            conn.close()
+            return
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        waiter.fire(conn)
+
+
+def send_ident(conn: socket.socket, ident: int) -> None:
+    conn.sendall(_IDENT.pack(ident))
+
+
+def recv_ident(conn: socket.socket) -> int:
+    data = b""
+    while len(data) < _IDENT.size:
+        chunk = conn.recv(_IDENT.size - len(data))
+        if not chunk:
+            raise OSError("closed during ident handshake")
+        data += chunk
+    return _IDENT.unpack(data)[0]

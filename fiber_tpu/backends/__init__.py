@@ -1,0 +1,104 @@
+"""Backend registry + auto-selection.
+
+Reference parity: fiber/backend.py:24-76 (memoizing factory; auto-selection
+sniffs the environment). fiber_tpu ships two backends:
+
+* ``local`` — jobs are subprocess children of this machine;
+* ``tpu``   — jobs are processes on TPU-VM pod-slice hosts (with a
+  single-host simulation mode for CI).
+
+Selection order: explicit ``name`` argument > ``FIBER_BACKEND`` env >
+config ``backend`` key > sniffing (TPU metadata/env) > ``local``.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import threading
+from typing import Dict, Optional
+
+from fiber_tpu import config
+from fiber_tpu.core import Backend
+
+available_backends = ("local", "tpu")
+
+_BACKEND_MODULES: Dict[str, str] = {
+    "local": "fiber_tpu.backends.local",
+    "tpu": "fiber_tpu.backends.tpu",
+}
+
+_backends: Dict[str, Backend] = {}
+_lock = threading.Lock()
+
+
+def _on_tpu_pod() -> bool:
+    """True when running on a TPU-VM host of a pod slice."""
+    if os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get(
+        "TPU_WORKER_ID"
+    ):
+        return True
+    return bool(config.get().tpu_name or config.get().tpu_hosts)
+
+
+def auto_select_backend() -> str:
+    name, _ = _select_backend()
+    return name
+
+
+def _select_backend():
+    """Returns (name, explicit). Explicit selections (env var / config key)
+    must not be silently substituted; only sniffed ones may fall back."""
+    env = os.environ.get("FIBER_BACKEND")
+    if env:
+        return env, True
+    cfg_backend = config.get().backend
+    if cfg_backend:
+        return cfg_backend, True
+    if _on_tpu_pod():
+        return "tpu", False
+    return "local", False
+
+
+def get_backend(name: Optional[str] = None) -> Backend:
+    """Memoized backend factory (reference: fiber/backend.py:56-76).
+
+    A backend requested explicitly (``name`` argument, ``FIBER_BACKEND``
+    env, or the config ``backend`` key) raises if it can't be loaded; only
+    a *sniffed* selection falls back to ``local`` with a warning, so
+    running on exotic hosts never hard-fails process creation.
+    """
+    sniffed = False
+    if name is None:
+        name, explicit = _select_backend()
+        sniffed = not explicit
+    try:
+        with _lock:
+            backend = _backends.get(name)
+            if backend is None:
+                modname = _BACKEND_MODULES.get(name)
+                if modname is None:
+                    raise ValueError(
+                        f"unknown backend {name!r}; "
+                        f"available: {available_backends}"
+                    )
+                module = importlib.import_module(modname)
+                backend = module.make_backend()
+                _backends[name] = backend
+            return backend
+    except Exception:
+        if not sniffed or name == "local":
+            raise
+        from fiber_tpu.utils.logging import get_logger
+
+        get_logger().warning(
+            "auto-selected backend %r unavailable; falling back to 'local'",
+            name, exc_info=True,
+        )
+        return get_backend("local")
+
+
+def reset_backends() -> None:
+    """Drop memoized backends (tests)."""
+    with _lock:
+        _backends.clear()

@@ -1,0 +1,88 @@
+"""Local backend: one job == one subprocess child of this machine.
+
+Reference parity: fiber/local_backend.py (create_job via subprocess.Popen,
+status from poll(), listen address 127.0.0.1). This is both the development
+backend and the building block the TPU backend composes per-host.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+import weakref
+from typing import List, Optional, Tuple
+
+from fiber_tpu.core import Backend, Job, JobSpec, ProcessStatus
+from fiber_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+
+class LocalBackend(Backend):
+    name = "local"
+
+    def __init__(self) -> None:
+        # Weak set so finished/GC'd Job handles don't pin Popen objects.
+        self._jobs: "weakref.WeakSet[Job]" = weakref.WeakSet()
+        self._lock = threading.Lock()
+
+    def create_job(self, job_spec: JobSpec) -> Job:
+        import os
+
+        env = None
+        if job_spec.env:
+            env = dict(os.environ)
+            env.update(job_spec.env)
+        proc = subprocess.Popen(
+            job_spec.command,
+            cwd=job_spec.cwd,
+            env=env,
+            start_new_session=False,
+        )
+        job = Job(proc, proc.pid)
+        job.host = "127.0.0.1"
+        with self._lock:
+            self._jobs.add(job)
+        logger.debug("local backend created job pid=%s", proc.pid)
+        return job
+
+    def get_job_status(self, job: Job) -> ProcessStatus:
+        proc: subprocess.Popen = job.data
+        if proc.poll() is None:
+            return ProcessStatus.STARTED
+        return ProcessStatus.STOPPED
+
+    def get_job_logs(self, job: Job) -> str:
+        return ""
+
+    def wait_for_job(self, job: Job, timeout: Optional[float]) -> Optional[int]:
+        proc: subprocess.Popen = job.data
+        try:
+            return proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            return None
+
+    def terminate_job(self, job: Job) -> None:
+        proc: subprocess.Popen = job.data
+        if proc.poll() is None:
+            proc.terminate()
+
+    def kill_job(self, job: Job) -> None:
+        proc: subprocess.Popen = job.data
+        if proc.poll() is None:
+            proc.kill()
+
+    def get_listen_addr(self) -> Tuple[str, int, str]:
+        return ("127.0.0.1", 0, "lo")
+
+    def list_jobs(self) -> List[Job]:
+        with self._lock:
+            return [
+                j
+                for j in list(self._jobs)
+                if j.data.poll() is None
+            ]
+
+
+def make_backend() -> LocalBackend:
+    return LocalBackend()

@@ -1,0 +1,166 @@
+"""Layered configuration for fiber_tpu.
+
+Three layers with rising priority (reference parity: fiber/config.py:15-65):
+
+1. config file — ``.fiberconfig`` in the current working directory (or a
+   path passed as ``conf_file=``), INI format, ``[default]`` section;
+2. environment — ``FIBER_<KEY>`` variables;
+3. code — ``fiber_tpu.init(key=value)`` / ``fiber_tpu.config.init(...)``.
+
+Unknown keys in the config file raise ``ValueError`` (reference:
+fiber/config.py:149-153). The resolved config is serialized into the spawn
+preparation data and re-applied inside every child process so the whole
+process tree sees one config (reference: fiber/spawn.py:59-60).
+"""
+
+from __future__ import annotations
+
+import configparser
+import copy
+import os
+from typing import Any, Dict, Optional
+
+DEFAULT_CONFIG_FILE = ".fiberconfig"
+ENV_PREFIX = "FIBER_"
+
+#: Default values; also the schema (key set + types) for file/env coercion.
+DEFAULTS: Dict[str, Any] = {
+    # --- scheduling / backend ---
+    "backend": "",           # "" = auto-select (local unless on a TPU pod)
+    "image": "",             # container/VM image for remote backends
+    "cpu_per_job": 1,        # local worker processes packed per job
+    "mem_per_job": 0,        # MB; 0 = backend default
+    # --- logging ---
+    "log_level": "INFO",
+    "log_file": "/tmp/fiber_tpu.log",   # "stdout" = log to stdout
+    # --- control plane (admin channel) ---
+    "ipc_active": True,      # worker dials master (False: master dials worker)
+    "ipc_admin_master_port": 0,     # 0 = random
+    "ipc_admin_worker_port": 8000,  # used only in passive mode
+    # --- data plane ---
+    "use_push_queue": True,
+    # --- TPU backend ---
+    "tpu_name": "",
+    "tpu_zone": "",
+    "tpu_project": "",
+    "tpu_hosts": "",          # comma-separated host list override / sim hosts
+    "mesh_shape": "",         # e.g. "8" or "4x2"; "" = all local devices
+    # --- misc ---
+    "debug": False,
+}
+
+_VALID_KEYS = frozenset(DEFAULTS)
+
+
+def _coerce(key: str, value: Any) -> Any:
+    """Coerce a string from file/env to the type of the default value."""
+    default = DEFAULTS[key]
+    if isinstance(value, str):
+        if isinstance(default, bool):
+            return value.strip().lower() in ("1", "true", "yes", "on")
+        if isinstance(default, int) and not isinstance(default, bool):
+            return int(value)
+    return value
+
+
+class Config:
+    """A resolved configuration: defaults < file < env < code kwargs."""
+
+    def __init__(self, conf_file: Optional[str] = None, **kwargs: Any) -> None:
+        self._values: Dict[str, Any] = copy.deepcopy(DEFAULTS)
+        self._load_file(conf_file)
+        self._load_env()
+        self.update(**kwargs)
+
+    def _load_file(self, conf_file: Optional[str]) -> None:
+        path = conf_file or os.path.join(os.getcwd(), DEFAULT_CONFIG_FILE)
+        if not os.path.exists(path):
+            if conf_file:
+                raise ValueError(f"config file not found: {conf_file}")
+            return
+        parser = configparser.ConfigParser()
+        parser.read(path)
+        if not parser.has_section("default"):
+            return
+        for key, raw in parser.items("default"):
+            if key not in _VALID_KEYS:
+                raise ValueError(
+                    f"invalid key in config file {path!r}: {key!r}"
+                )
+            self._values[key] = _coerce(key, raw)
+
+    def _load_env(self) -> None:
+        for key in _VALID_KEYS:
+            env = os.environ.get(ENV_PREFIX + key.upper())
+            if env is not None:
+                self._values[key] = _coerce(key, env)
+
+    def update(self, **kwargs: Any) -> None:
+        for key, value in kwargs.items():
+            if key == "conf_file":
+                continue
+            if key not in _VALID_KEYS:
+                raise ValueError(f"invalid config key: {key!r}")
+            self._values[key] = _coerce(key, value)
+
+    def __getattr__(self, key: str) -> Any:
+        try:
+            return self.__dict__["_values"][key]
+        except KeyError:
+            raise AttributeError(key) from None
+
+    def __setattr__(self, key: str, value: Any) -> None:
+        if key.startswith("_"):
+            super().__setattr__(key, value)
+        else:
+            self.update(**{key: value})
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"Config({self._values!r})"
+
+
+_current: Config = Config()
+
+
+def get() -> Config:
+    """Return the process-wide config object."""
+    return _current
+
+
+def init(conf_file: Optional[str] = None, **kwargs: Any) -> Config:
+    """Rebuild the process-wide config: defaults < file < env < kwargs."""
+    global _current
+    _current = Config(conf_file=conf_file, **kwargs)
+    return _current
+
+
+def init_from(values: Dict[str, Any]) -> Config:
+    """Adopt a fully-resolved config dict (used by the worker bootstrap so a
+    child sees exactly the parent's config — reference: fiber/spawn.py:59-60).
+    """
+    global _current
+    cfg = Config.__new__(Config)
+    cfg._values = copy.deepcopy(DEFAULTS)
+    cfg._values.update({k: v for k, v in values.items() if k in _VALID_KEYS})
+    _current = cfg
+    return _current
+
+
+def reset() -> Config:
+    """Reset to pure defaults (no file/env), mainly for tests."""
+    global _current
+    cfg = Config.__new__(Config)
+    cfg._values = copy.deepcopy(DEFAULTS)
+    _current = cfg
+    return _current
+
+
+def __getattr__(name: str) -> Any:
+    """Module-level attribute access proxies the current config
+    (``fiber_tpu.config.backend`` etc., reference exposes module globals)."""
+    if name in _VALID_KEYS:
+        return getattr(_current, name)
+    raise AttributeError(name)

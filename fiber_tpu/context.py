@@ -1,0 +1,94 @@
+"""The context object — factory for every user-facing primitive.
+
+Reference parity: fiber/context.py:20-76. Only the spawn start-method
+exists: every fiber_tpu process is a fresh interpreter started through a
+backend job, never a fork. Imports are lazy so the package root stays cheap
+and the layers can be built/tested bottom-up.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+from typing import Any, Optional
+
+
+class FiberContext:
+    _name = "spawn"
+
+    # -- processes --------------------------------------------------------
+    @property
+    def Process(self):
+        from fiber_tpu.process import Process
+
+        return Process
+
+    def current_process(self):
+        from fiber_tpu import process
+
+        return process.current_process()
+
+    def active_children(self):
+        from fiber_tpu import process
+
+        return process.active_children()
+
+    # -- pools ------------------------------------------------------------
+    def Pool(
+        self,
+        processes: Optional[int] = None,
+        initializer=None,
+        initargs=(),
+        maxtasksperchild: Optional[int] = None,
+        error_handling: bool = True,
+        **kwargs: Any,
+    ):
+        """Create a distributed pool. ``error_handling=True`` (default)
+        returns the resilient pool with task resubmission on worker death
+        (reference: fiber/context.py:38-45 chooses ResilientZPool/ZPool)."""
+        from fiber_tpu.pool import Pool, ResilientPool
+
+        cls = ResilientPool if error_handling else Pool
+        return cls(
+            processes,
+            initializer=initializer,
+            initargs=initargs,
+            maxtasksperchild=maxtasksperchild,
+            **kwargs,
+        )
+
+    # -- queues / pipes ----------------------------------------------------
+    def SimpleQueue(self):
+        from fiber_tpu.queues import SimpleQueue
+
+        return SimpleQueue()
+
+    def Pipe(self, duplex: bool = True):
+        from fiber_tpu.queues import Pipe
+
+        return Pipe(duplex)
+
+    # -- managers ----------------------------------------------------------
+    def Manager(self):
+        from fiber_tpu.managers import SyncManager
+
+        manager = SyncManager()
+        manager.start()
+        return manager
+
+    def AsyncManager(self):
+        from fiber_tpu.managers import AsyncManager
+
+        manager = AsyncManager()
+        manager.start()
+        return manager
+
+    # -- misc --------------------------------------------------------------
+    def cpu_count(self) -> int:
+        return multiprocessing.cpu_count()
+
+    def get_context(self, method: Optional[str] = None) -> "FiberContext":
+        if method not in (None, "spawn"):
+            raise ValueError(
+                f"fiber_tpu only supports the 'spawn' start method, not {method!r}"
+            )
+        return self

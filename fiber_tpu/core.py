@@ -1,0 +1,115 @@
+"""Core abstractions: Backend interface, JobSpec, Job, ProcessStatus.
+
+This is the load-bearing seam of the whole framework (reference parity:
+fiber/core.py:18-113). Everything above it — Process, Pool, Managers, Ring,
+the CLI — only ever talks to a Backend through these six methods, which is
+what makes the test suite's fault injection a five-line subclass and lets
+the same user program run on local subprocesses, a simulated multi-host
+cluster, or a real TPU pod slice unchanged.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+class ProcessStatus(enum.Enum):
+    INITIAL = 0
+    STARTED = 1
+    STOPPED = 2
+
+
+class JobSpec:
+    """Everything a backend needs to start one job (one framework process).
+
+    Reference parity: fiber/core.py:28-57.
+    """
+
+    def __init__(
+        self,
+        command: Sequence[str],
+        image: Optional[str] = None,
+        name: str = "fiber-tpu-job",
+        cpu: Optional[int] = None,
+        mem: Optional[int] = None,
+        gpu: Optional[int] = None,
+        tpu: Optional[int] = None,
+        env: Optional[Dict[str, str]] = None,
+        cwd: Optional[str] = None,
+        volumes: Optional[Dict[str, Dict[str, str]]] = None,
+        host_hint: Optional[str] = None,
+    ) -> None:
+        self.command = list(command)
+        self.image = image
+        self.name = name
+        self.cpu = cpu
+        self.mem = mem
+        self.gpu = gpu
+        self.tpu = tpu
+        self.env = dict(env or {})
+        self.cwd = cwd
+        self.volumes = dict(volumes or {})
+        # Placement hint for multi-host backends (e.g. pin to pod host k).
+        self.host_hint = host_hint
+
+    def __repr__(self) -> str:
+        return (
+            f"JobSpec(name={self.name!r}, cpu={self.cpu}, mem={self.mem}, "
+            f"tpu={self.tpu}, host_hint={self.host_hint!r})"
+        )
+
+
+class Job:
+    """Handle to a created job. ``data`` is backend-private (a Popen object,
+    a TPU-VM worker descriptor, ...). Reference parity: fiber/core.py:60-76.
+    """
+
+    def __init__(self, data: Any, jid: Any) -> None:
+        self.data = data
+        self.jid = jid
+        self.host: Optional[str] = None
+        self.update()
+
+    def update(self) -> None:
+        """Refresh cached fields (host/ip) from backend data."""
+
+
+class Backend:
+    """Abstract scheduler driver — the six-method interface.
+
+    Reference parity: fiber/core.py:79-113. Subclass and override all six;
+    tests inject faults by subclassing and breaking ``create_job``.
+    """
+
+    name = "abstract"
+
+    def create_job(self, job_spec: JobSpec) -> Job:
+        raise NotImplementedError
+
+    def get_job_status(self, job: Job) -> ProcessStatus:
+        raise NotImplementedError
+
+    def get_job_logs(self, job: Job) -> str:
+        raise NotImplementedError
+
+    def wait_for_job(self, job: Job, timeout: Optional[float]) -> Optional[int]:
+        """Block until the job exits; return exit code (None on timeout)."""
+        raise NotImplementedError
+
+    def terminate_job(self, job: Job) -> None:
+        raise NotImplementedError
+
+    def kill_job(self, job: Job) -> None:
+        """Force-kill (SIGKILL semantics). Defaults to terminate_job for
+        backends without a distinct hard-kill path."""
+        self.terminate_job(job)
+
+    def get_listen_addr(self) -> Tuple[str, int, str]:
+        """(ip, port, ifname) other processes of this tree should dial.
+        port==0 means "caller picks a random port"."""
+        raise NotImplementedError
+
+    # --- optional capabilities -------------------------------------------
+    def list_jobs(self) -> List[Job]:  # pragma: no cover - optional
+        """Live jobs created by this backend (leak-check fixture support)."""
+        return []

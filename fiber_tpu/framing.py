@@ -1,0 +1,71 @@
+"""Length-prefixed message framing shared by the admin channel and the
+host-plane transport.
+
+Wire format: 8-byte big-endian unsigned length, then payload. One framing
+for everything (the reference uses three: nanomsg's own, raw struct-packed
+admin messages, and multiprocessing.connection — fiber/socket.py,
+fiber/popen_fiber_spawn.py:56-72, fiber/managers.py:26-31; unifying them is
+deliberate simplification).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Optional
+
+_LEN = struct.Struct(">Q")
+
+#: Sanity ceiling for one frame (1 TiB) — catches corrupted streams early.
+MAX_FRAME = 1 << 40
+
+
+class ConnectionClosed(OSError):
+    """Peer closed the connection mid-frame or before a frame."""
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    header = _LEN.pack(len(payload))
+    if len(payload) > 65536:
+        # Avoid duplicating large payloads (host-plane tensors) in memory.
+        sock.sendall(header)
+        sock.sendall(payload)
+    else:
+        sock.sendall(header + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    remaining = n
+    while remaining:
+        chunk = sock.recv(min(remaining, 1 << 20))
+        if not chunk:
+            raise ConnectionClosed("connection closed while reading frame")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    header = _recv_exact(sock, _LEN.size)
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise OSError(f"frame too large: {length}")
+    if length == 0:
+        return b""
+    return _recv_exact(sock, length)
+
+
+def recv_frame_timeout(
+    sock: socket.socket, timeout: Optional[float]
+) -> Optional[bytes]:
+    """recv_frame with a timeout; returns None if no frame *starts* within
+    the timeout. The wait applies only before the first byte — once a frame
+    has begun, it is read to completion, so a timeout can never strand
+    partially-consumed bytes and desynchronize the stream."""
+    import select
+
+    readable, _, _ = select.select([sock], [], [], timeout)
+    if not readable:
+        return None
+    return recv_frame(sock)

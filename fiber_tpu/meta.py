@@ -1,0 +1,63 @@
+"""``@fiber_tpu.meta`` — per-function resource + placement hints.
+
+Reference parity: fiber/meta.py:28-58 (attaches ``__fiber_meta__`` to the
+function; Popen merges it into the JobSpec at launch —
+fiber/popen_fiber_spawn.py:265-273; Pool enforces that all tasks in one pool
+share compatible meta — fiber/pool.py:1122-1134).
+
+TPU-native extension: ``device=True`` marks a function as jittable and pure,
+which lets ``Pool.map`` lower it to the on-device ``shard_map`` path instead
+of shipping it to host workers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict
+
+META_ATTR = "__fiber_meta__"
+
+VALID_META_KEYS = frozenset({"cpu", "mem", "gpu", "tpu", "device"})
+_RENAMES = {"memory": "mem"}
+
+
+def meta(**kwargs: Any) -> Callable:
+    """Decorator attaching resource/placement hints to a task function.
+
+    Usage::
+
+        @fiber_tpu.meta(cpu=4, memory=2000)
+        def heavy(x): ...
+
+        @fiber_tpu.meta(device=True)
+        def rollout(params, seed): ...   # jittable -> runs on-device
+    """
+    hints: Dict[str, Any] = {}
+    for key, value in kwargs.items():
+        key = _RENAMES.get(key, key)
+        if key not in VALID_META_KEYS:
+            raise ValueError(f"invalid meta key: {key!r}")
+        hints[key] = value
+
+    def decorator(fn: Callable) -> Callable:
+        existing = getattr(fn, META_ATTR, None)
+        merged = dict(existing or {})
+        merged.update(hints)
+        try:
+            setattr(fn, META_ATTR, merged)
+            return fn
+        except AttributeError:
+            # builtins / partials without settable attrs: wrap.
+            @functools.wraps(fn)
+            def wrapper(*a: Any, **kw: Any) -> Any:
+                return fn(*a, **kw)
+
+            setattr(wrapper, META_ATTR, merged)
+            return wrapper
+
+    return decorator
+
+
+def get_meta(fn: Callable) -> Dict[str, Any]:
+    """Return the hints attached to ``fn`` (empty dict if none)."""
+    return dict(getattr(fn, META_ATTR, {}) or {})

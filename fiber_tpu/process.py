@@ -1,0 +1,288 @@
+"""``fiber_tpu.Process`` — a multiprocessing-compatible Process whose body
+runs inside a backend job (a subprocess locally; a TPU-VM host process on a
+pod slice).
+
+Reference parity: fiber/process.py (Process, current_process,
+active_children). This is an original implementation, not a BaseProcess
+subclass: the full lifecycle state machine lives here, and the launch
+protocol lives in fiber_tpu/launcher.py.
+"""
+
+from __future__ import annotations
+
+import atexit
+import itertools
+import os
+import sys
+import threading
+import traceback
+from typing import Any, Dict, Iterable, List, Optional
+
+from fiber_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+_counter = itertools.count(1)
+_children: "set[Process]" = set()
+_children_lock = threading.Lock()
+
+
+class Process:
+    """A process started through the backend seam.
+
+    Supported API (mirrors ``multiprocessing.Process``): start, join,
+    is_alive, terminate, kill, run, name, daemon, pid/ident, exitcode,
+    sentinel, authkey.
+    """
+
+    def __init__(
+        self,
+        group: None = None,
+        target=None,
+        name: Optional[str] = None,
+        args: Iterable[Any] = (),
+        kwargs: Optional[Dict[str, Any]] = None,
+        *,
+        daemon: Optional[bool] = None,
+        backend: Optional[str] = None,
+        host_hint: Optional[str] = None,
+    ) -> None:
+        if group is not None:
+            raise ValueError("process group argument must be None")
+        self._target = target
+        self._args = tuple(args)
+        self._kwargs = dict(kwargs or {})
+        self._name = name or f"Process-{next(_counter)}"
+        self._daemonic = bool(daemon) if daemon is not None else False
+        self._authkey = bytes(current_process().authkey)
+        self._backend_name = backend
+        self._host_hint = host_hint
+        self._launcher = None
+        self._pid: Optional[int] = None
+        self._closed = False
+        self._worker_side = False
+
+    # -- attributes -------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @name.setter
+    def name(self, value: str) -> None:
+        self._name = str(value)
+
+    @property
+    def daemon(self) -> bool:
+        return self._daemonic
+
+    @daemon.setter
+    def daemon(self, value: bool) -> None:
+        if self._launcher is not None:
+            raise AssertionError("cannot set daemon status of active process")
+        self._daemonic = bool(value)
+
+    @property
+    def authkey(self) -> bytes:
+        return self._authkey
+
+    @authkey.setter
+    def authkey(self, value: bytes) -> None:
+        self._authkey = bytes(value)
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self._pid
+
+    ident = pid
+
+    @property
+    def exitcode(self) -> Optional[int]:
+        if self._launcher is None:
+            return None
+        return self._launcher.poll()
+
+    @property
+    def sentinel(self) -> int:
+        """A selectable fd that becomes ready when the process exits (the
+        admin socket; the worker end closes at process exit)."""
+        if self._launcher is None or self._launcher.conn is None:
+            raise ValueError("process not started or already closed")
+        return self._launcher.sentinel
+
+    @property
+    def job(self):
+        """Backend job handle (fiber_tpu extension, handy in tests)."""
+        return self._launcher.job if self._launcher else None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        from fiber_tpu.launcher import JobLauncher
+
+        if self._closed:
+            raise ValueError("process object is closed")
+        if self._launcher is not None:
+            raise AssertionError("cannot start a process twice")
+        if self._worker_side:
+            raise AssertionError("cannot restart the in-worker process object")
+        self._launcher = JobLauncher(self)
+        self._pid = self._launcher.pid
+        with _children_lock:
+            _children.add(self)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._launcher is None:
+            raise AssertionError("can only join a started process")
+        rc = self._launcher.wait(timeout)
+        if rc is not None:
+            with _children_lock:
+                _children.discard(self)
+
+    def is_alive(self) -> bool:
+        if self._launcher is None or self._closed:
+            return False
+        alive = self._launcher.poll() is None
+        if not alive:
+            with _children_lock:
+                _children.discard(self)
+        return alive
+
+    def terminate(self) -> None:
+        if self._launcher is None:
+            raise AssertionError("can only terminate a started process")
+        self._launcher.terminate()
+
+    def kill(self) -> None:
+        if self._launcher is None:
+            raise AssertionError("can only kill a started process")
+        self._launcher.kill()
+
+    def close(self) -> None:
+        if self._launcher is not None:
+            if self._launcher.poll() is None:
+                raise ValueError("cannot close a process while it is running")
+            self._launcher.close()
+        with _children_lock:
+            _children.discard(self)
+        self._closed = True
+
+    def run(self) -> None:
+        if self._target:
+            self._target(*self._args, **self._kwargs)
+
+    def __repr__(self) -> str:
+        if self._launcher is None:
+            state = "initial"
+        else:
+            rc = self._launcher.returncode
+            state = "started" if rc is None else f"stopped[{rc}]"
+        return f"<{type(self).__name__}({self._name}, {state})>"
+
+    # -- pickling (master -> worker shipping) ------------------------------
+    def __getstate__(self) -> Dict[str, Any]:
+        return {
+            "_target": self._target,
+            "_args": self._args,
+            "_kwargs": self._kwargs,
+            "_name": self._name,
+            "_daemonic": self._daemonic,
+            "_authkey": self._authkey,
+            "_backend_name": self._backend_name,
+            "_host_hint": self._host_hint,
+            "_pid": self._pid,
+        }
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        self._launcher = None
+        self._closed = False
+        self._worker_side = True
+
+    # -- worker side ------------------------------------------------------
+    def _bootstrap(self) -> int:
+        """Run the process body in the worker (reference:
+        fiber/process.py:264-323). Returns the exit code."""
+        global _current_process
+        _current_process = self
+        try:
+            self.run()
+            return 0
+        except SystemExit as exc:
+            code = exc.code
+            if code is None:
+                return 0
+            if isinstance(code, int):
+                return code
+            sys.stderr.write(str(code) + "\n")
+            return 1
+        except Exception:
+            sys.stderr.write(
+                f"Process {self._name}:\n{traceback.format_exc()}"
+            )
+            return 1
+        finally:
+            sys.stdout.flush()
+            sys.stderr.flush()
+
+
+class _MainProcess(Process):
+    def __init__(self) -> None:
+        self._target = None
+        self._args = ()
+        self._kwargs = {}
+        self._name = "MainProcess"
+        self._daemonic = False
+        self._authkey = os.urandom(32)
+        self._backend_name = None
+        self._host_hint = None
+        self._launcher = None
+        self._pid = os.getpid()
+        self._closed = False
+        self._worker_side = False
+
+
+_current_process: Process = _MainProcess()
+
+
+def current_process() -> Process:
+    """The Process object for this interpreter (reference:
+    fiber/process.py:55-80)."""
+    return _current_process
+
+
+def active_children() -> List[Process]:
+    """Live children of this process; reaps finished ones as a side effect."""
+    with _children_lock:
+        children = list(_children)
+    result = []
+    for child in children:
+        if child.is_alive():
+            result.append(child)
+    return result
+
+
+def _set_current_process(proc: Process) -> None:
+    global _current_process
+    _current_process = proc
+
+
+@atexit.register
+def _exit_cleanup() -> None:
+    """Terminate daemonic children, join the rest (multiprocessing exit
+    semantics; the worker-side watchdog additionally reaps orphans whose
+    master vanished without running atexit)."""
+    with _children_lock:
+        children = list(_children)
+    for child in children:
+        try:
+            if child.daemon:
+                child.terminate()
+        except Exception:
+            pass
+    for child in children:
+        try:
+            if child.daemon:
+                child.join(5.0)
+            else:
+                child.join()
+        except Exception:
+            pass

@@ -1,0 +1,91 @@
+"""Object serialization for the control and data planes.
+
+Policy (reference parity: fiber/popen_fiber_spawn.py:348-354, pool.py:60-63):
+use the stdlib ``multiprocessing.reduction.ForkingPickler`` for normal
+programs, and fall back to **cloudpickle** when the object graph needs
+pickling-by-value (interactive shells, closures, lambdas).
+
+TPU-native extension: a reducer for ``jax.Array`` so device arrays can ride
+the host plane — they are pulled to host memory as numpy on serialize and
+re-materialized with ``jax.device_put`` on deserialize. Cross-host device
+state otherwise never touches pickle: bulk tensors move on the ICI plane via
+collectives, not the host plane.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any
+
+from multiprocessing.reduction import ForkingPickler
+
+from fiber_tpu.utils.misc import is_in_interactive_console
+
+try:
+    import cloudpickle
+except ImportError:  # pragma: no cover
+    cloudpickle = None
+
+
+def _jax_array_reduce(arr):
+    import jax
+    import numpy as np
+
+    host = np.asarray(arr)
+    return (_jax_array_rebuild, (host,))
+
+
+def _jax_array_rebuild(host):
+    import jax
+
+    return jax.device_put(host)
+
+
+_jax_reducer_registered = False
+
+
+def register_jax_reducers() -> None:
+    """Register the jax.Array reducer on both picklers (idempotent, lazy —
+    only ever called once jax is already imported by user code)."""
+    global _jax_reducer_registered
+    if _jax_reducer_registered:
+        return
+    import sys
+
+    if "jax" not in sys.modules:
+        return
+    import jax
+
+    ForkingPickler.register(jax.Array, _jax_array_reduce)
+    try:
+        # Concrete array class may differ from the jax.Array ABC.
+        concrete = type(jax.numpy.zeros(()))
+        ForkingPickler.register(concrete, _jax_array_reduce)
+    except Exception:
+        pass
+    _jax_reducer_registered = True
+
+
+def dumps(obj: Any) -> bytes:
+    """Serialize with the stdlib reducer; cloudpickle on failure or in
+    interactive sessions."""
+    register_jax_reducers()
+    if cloudpickle is not None and is_in_interactive_console():
+        return cloudpickle.dumps(obj)
+    try:
+        buf = io.BytesIO()
+        ForkingPickler(buf, pickle.HIGHEST_PROTOCOL).dump(obj)
+        return buf.getvalue()
+    except (pickle.PicklingError, AttributeError, TypeError):
+        if cloudpickle is None:
+            raise
+        return cloudpickle.dumps(obj)
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)
+
+
+def dump_to(obj: Any, fileobj) -> None:
+    fileobj.write(dumps(obj))

@@ -1,0 +1,8 @@
+"""Utility subpackage: logging, networking, small shared helpers."""
+
+from fiber_tpu.utils.misc import Finalize, register_after_fork  # noqa: F401
+from fiber_tpu.utils.net import (  # noqa: F401
+    find_listen_address,
+    find_ip_by_net_interface,
+    random_port_bind,
+)

@@ -1,0 +1,55 @@
+"""Per-process logging for fiber_tpu.
+
+Reference parity: fiber/init.py:25-49 — one log file per process, named
+``<log_file>.<process_name>``, plus a ``stdout`` special value. The master
+initializes at import; workers re-init inside the spawn bootstrap after the
+parent's config has been adopted, so every process in the tree logs to its
+own file with one shared format (tested by reference tests/test_misc.py
+per-process log-file separation).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+LOGGER_NAME = "fiber_tpu"
+
+FORMAT = (
+    "%(asctime)s %(levelname)s:%(processName)s(%(process)d)"
+    ":%(threadName)s:%(name)s {%(filename)s:%(lineno)d} %(message)s"
+)
+
+
+def get_logger() -> logging.Logger:
+    return logging.getLogger(LOGGER_NAME)
+
+
+def init_logger(cfg, process_name: str | None = None) -> logging.Logger:
+    """(Re)configure the fiber_tpu logger from a resolved Config."""
+    import multiprocessing
+
+    logger = get_logger()
+    for handler in list(logger.handlers):
+        logger.removeHandler(handler)
+        try:
+            handler.close()
+        except Exception:
+            pass
+
+    level = getattr(logging, str(cfg.log_level).upper(), logging.INFO)
+    logger.setLevel(level)
+    logger.propagate = False
+
+    if cfg.log_file == "stdout":
+        handler: logging.Handler = logging.StreamHandler(sys.stdout)
+    else:
+        name = process_name or multiprocessing.current_process().name
+        path = "{}.{}".format(cfg.log_file, name)
+        try:
+            handler = logging.FileHandler(path)
+        except OSError:
+            handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(FORMAT))
+    logger.addHandler(handler)
+    return logger

@@ -1,0 +1,99 @@
+"""Small shared helpers: fork registry, finalizers, interactive detection.
+
+Reference parity: fiber/util.py:33-67 (register_after_fork / Finalize) and
+fiber/util.py:127-131 (interactive-console detection, which selects
+cloudpickle over the stdlib reducer for shipping __main__-less closures —
+fiber/popen_fiber_spawn.py:348-354).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+import threading
+import weakref
+from typing import Any, Callable, Optional
+
+_afterfork_registry: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
+_afterfork_counter = itertools.count()
+
+
+def register_after_fork(obj: Any, func: Callable[[Any], None]) -> None:
+    _afterfork_registry[(next(_afterfork_counter), id(obj), func)] = obj
+
+
+def run_after_forkers() -> None:
+    items = list(_afterfork_registry.items())
+    items.sort()
+    for (_, _, func), obj in items:
+        try:
+            func(obj)
+        except Exception:
+            pass
+
+
+class Finalize:
+    """Callback run at object GC or process exit, priority ordered."""
+
+    _registry: dict = {}
+    _counter = itertools.count()
+    _lock = threading.Lock()
+
+    def __init__(self, obj, callback, args=(), kwargs=None, exitpriority=None):
+        self._callback = callback
+        self._args = args
+        self._kwargs = kwargs or {}
+        self._key = (exitpriority, next(self._counter))
+        self._weakref = (
+            weakref.ref(obj, self) if obj is not None else None
+        )
+        with self._lock:
+            self._registry[self._key] = self
+
+    def __call__(self, wr=None):
+        with self._lock:
+            if self._registry.pop(self._key, None) is None:
+                return None
+        callback, args, kwargs = self._callback, self._args, self._kwargs
+        self._callback = None
+        return callback(*args, **kwargs)
+
+    def cancel(self) -> None:
+        with self._lock:
+            self._registry.pop(self._key, None)
+        self._callback = None
+
+    def still_active(self) -> bool:
+        return self._callback is not None
+
+    @classmethod
+    def run_all(cls, minpriority: Optional[int] = None) -> None:
+        with cls._lock:
+            items = sorted(cls._registry.items(), reverse=True)
+        for key, finalizer in items:
+            prio = key[0]
+            if prio is None:
+                continue
+            if minpriority is not None and prio < minpriority:
+                continue
+            finalizer()
+
+
+def is_in_interactive_console() -> bool:
+    """True in a REPL / notebook, where __main__ has no file and functions
+    defined at the prompt can only travel via cloudpickle (selects the
+    serializer — see fiber_tpu/serialization.py)."""
+    main = sys.modules.get("__main__")
+    return main is None or not hasattr(main, "__file__")
+
+
+def mib(nbytes: int) -> float:
+    return nbytes / (1024.0 * 1024.0)
+
+
+def getenv_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default

@@ -1,0 +1,81 @@
+"""Network helpers: listen-address discovery and random-port binding.
+
+Reference parity: fiber/util.py:70-124 (NIC scan for an externally reachable
+IPv4 address) and fiber/socket.py:23-24,48-63 (random bind in 40000-65535,
+100 tries). On TPU-VM hosts the eth0 address is what other pod hosts dial
+over DCN, so the same scan applies.
+"""
+
+from __future__ import annotations
+
+import random
+import socket as pysocket
+from typing import Optional, Tuple
+
+PORT_RANGE = (40000, 65535)
+BIND_TRIES = 100
+
+
+def find_ip_by_net_interface(ifname: str) -> Optional[str]:
+    """IPv4 address of a specific interface, or None."""
+    try:
+        import psutil
+
+        addrs = psutil.net_if_addrs().get(ifname, [])
+        for addr in addrs:
+            if addr.family == pysocket.AF_INET:
+                return addr.address
+    except ImportError:
+        pass
+    return None
+
+
+def find_listen_address() -> Optional[str]:
+    """Best externally-reachable IPv4 address of this host.
+
+    Scans ``eth*`` / ``en*`` / ``ens*`` interfaces first (reference:
+    fiber/util.py:111-124); falls back to the UDP-connect trick; finally
+    127.0.0.1.
+    """
+    try:
+        import psutil
+
+        candidates = []
+        for ifname, addrs in psutil.net_if_addrs().items():
+            if not (ifname.startswith("eth") or ifname.startswith("en")):
+                continue
+            for addr in addrs:
+                if addr.family == pysocket.AF_INET:
+                    candidates.append(addr.address)
+        if candidates:
+            return candidates[0]
+    except ImportError:
+        pass
+    # UDP connect trick: no packets sent; works without psutil.
+    try:
+        s = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
+def random_port_bind(
+    sock: pysocket.socket, host: str = ""
+) -> Tuple[str, int]:
+    """Bind ``sock`` to a random port in PORT_RANGE (reference port policy).
+
+    Returns (host, port). Raises OSError after BIND_TRIES failures.
+    """
+    last_err: Optional[OSError] = None
+    for _ in range(BIND_TRIES):
+        port = random.randint(*PORT_RANGE)
+        try:
+            sock.bind((host, port))
+            return host, port
+        except OSError as err:
+            last_err = err
+    raise last_err if last_err else OSError("could not bind a random port")

@@ -1,0 +1,176 @@
+"""Worker-side bootstrap: ``python -m fiber_tpu.worker``.
+
+Reference parity: fiber/spawn.py (spawn_prepare + the master-death
+watchdog) and the ``python -c`` bootstrap templates in
+fiber/popen_fiber_spawn.py:43-77. Sequence:
+
+1. dial the master's admin server and send our launch ident (active mode),
+   or listen on the fixed admin port and accept the master's dial-in
+   (passive mode, ``ipc_active=False``);
+2. receive the preparation frame: adopt the parent's config, sys.path,
+   logging, and re-import the user's __main__ so pickled targets resolve;
+3. receive the Process frame and run ``_bootstrap()``;
+4. a watchdog thread blocks on the admin socket: if it closes (master died
+   or reaped us), SIGTERM ourselves, then hard-exit after a grace period.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+
+# Must be set before fiber_tpu is imported so the package skips
+# master-style logger init (fiber_tpu/__init__.py).
+os.environ.setdefault("FIBER_WORKER", "1")
+
+_worker_done = threading.Event()
+
+
+def _apply_preparation(prep: dict) -> None:
+    import multiprocessing
+    import multiprocessing.spawn as mp_spawn
+
+    from fiber_tpu import config
+    from fiber_tpu.utils import logging as flogging
+
+    cwd = prep.get("cwd")
+    if cwd and os.path.isdir(cwd):
+        os.chdir(cwd)
+
+    for path in reversed(prep.get("sys_path", [])):
+        if path not in sys.path:
+            sys.path.insert(0, path)
+
+    config.init_from(prep["fiber_config"])
+
+    name = prep.get("name", "FiberWorker")
+    mp_proc = multiprocessing.current_process()
+    mp_proc.name = name  # so %(processName)s in log lines matches
+    authkey = prep.get("authkey")
+    if authkey:
+        mp_proc.authkey = authkey
+
+    flogging.init_logger(config.get(), process_name=name)
+
+    sys_argv = prep.get("sys_argv")
+    if sys_argv:
+        sys.argv = list(sys_argv)
+
+    # Re-import the user's entry module so functions pickled by reference
+    # against __main__ resolve (the stdlib spawn fixups are the canonical
+    # implementation of this dance).
+    try:
+        if "init_main_from_name" in prep:
+            mp_spawn._fixup_main_from_name(prep["init_main_from_name"])
+        elif "init_main_from_path" in prep:
+            mp_spawn._fixup_main_from_path(prep["init_main_from_path"])
+    except Exception:
+        # A broken/unimportable main is survivable when targets don't
+        # actually live there; unpickling will raise if they do.
+        pass
+
+
+def _start_watchdog(conn: socket.socket) -> None:
+    def watch() -> None:
+        try:
+            while True:
+                data = conn.recv(1)
+                if not data:
+                    break
+        except OSError:
+            pass
+        if _worker_done.is_set():
+            return
+        # Master is gone: mirror the reference watchdog
+        # (fiber/spawn.py:33-51) — SIGTERM for a chance at cleanup, then
+        # hard exit.
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)
+        except OSError:
+            pass
+        time.sleep(5.0)
+        if not _worker_done.is_set():
+            os._exit(1)
+
+    threading.Thread(target=watch, name="fiber-watchdog", daemon=True).start()
+
+
+def _connect_active(master: str, ident: int) -> socket.socket:
+    host, port_s = master.rsplit(":", 1)
+    conn = socket.create_connection((host, int(port_s)), timeout=30.0)
+    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    from fiber_tpu.admin import send_ident
+
+    send_ident(conn, ident)
+    conn.settimeout(None)
+    return conn
+
+
+def _listen_passive(port: int, ident: int) -> socket.socket:
+    from fiber_tpu.admin import recv_ident, send_ident
+
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    listener.bind(("", port))
+    listener.listen(1)
+    while True:
+        conn, _ = listener.accept()
+        try:
+            got = recv_ident(conn)
+        except OSError:
+            conn.close()
+            continue
+        if got != ident:
+            # Another launch's master found us on the shared fixed port;
+            # close so it retries until it reaches its own worker.
+            conn.close()
+            continue
+        listener.close()
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        send_ident(conn, ident)  # ack: confirms the master reached *us*
+        return conn
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="fiber_tpu.worker")
+    parser.add_argument("--ident", type=int, required=True)
+    parser.add_argument("--master", default="")
+    parser.add_argument("--listen", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    if args.master:
+        conn = _connect_active(args.master, args.ident)
+    elif args.listen:
+        conn = _listen_passive(args.listen, args.ident)
+    else:
+        parser.error("need --master (active) or --listen (passive)")
+
+    from fiber_tpu import serialization
+    from fiber_tpu.framing import recv_frame
+    from fiber_tpu import process as fprocess
+
+    prep = serialization.loads(recv_frame(conn))
+    _apply_preparation(prep)
+
+    process_obj = serialization.loads(recv_frame(conn))
+    fprocess._set_current_process(process_obj)
+
+    _start_watchdog(conn)
+    try:
+        exitcode = process_obj._bootstrap()
+    finally:
+        _worker_done.set()
+    try:
+        conn.close()
+    except OSError:
+        pass
+    return exitcode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

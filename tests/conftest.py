@@ -1,0 +1,42 @@
+"""Shared test setup.
+
+* Forces the CPU platform with 8 virtual devices so mesh/sharding tests run
+  anywhere (the driver separately dry-runs the multi-chip path).
+* Leak-check fixture (reference parity: the autouse fixture asserting
+  ``fiber.active_children() == []`` before/after every test —
+  tests/test_pool.py:75-84 etc. in the reference): every test must clean up
+  every process it started.
+"""
+
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("FIBER_BACKEND", "local")
+os.environ.setdefault("FIBER_LOG_FILE", "/tmp/fiber_tpu_test.log")
+
+import pytest  # noqa: E402
+
+import fiber_tpu  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def leak_check():
+    assert fiber_tpu.active_children() == [], "leaked processes from earlier test"
+    yield
+    deadline = time.time() + 15
+    while fiber_tpu.active_children() and time.time() < deadline:
+        time.sleep(0.05)
+    leftover = fiber_tpu.active_children()
+    for proc in leftover:
+        try:
+            proc.terminate()
+            proc.join(5)
+        except Exception:
+            pass
+    assert leftover == [], f"test leaked processes: {leftover}"

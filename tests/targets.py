@@ -1,0 +1,70 @@
+"""Module-level task functions shipped to worker processes by the tests
+(must be importable by reference in the child interpreter)."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+
+def noop() -> None:
+    pass
+
+
+def sleep_for(seconds: float) -> None:
+    time.sleep(seconds)
+
+
+def sleep_forever() -> None:
+    while True:
+        time.sleep(3600)
+
+
+def exit_with(code: int) -> None:
+    sys.exit(code)
+
+
+def raise_error() -> None:
+    raise ValueError("intentional test error")
+
+
+def write_file(path: str, content: str) -> None:
+    with open(path, "w") as fh:
+        fh.write(content)
+
+
+def write_process_name(path: str) -> None:
+    import fiber_tpu
+
+    with open(path, "w") as fh:
+        fh.write(fiber_tpu.current_process().name)
+
+
+def write_config_value(path: str, key: str) -> None:
+    from fiber_tpu import config
+
+    with open(path, "w") as fh:
+        fh.write(str(getattr(config.get(), key)))
+
+
+def square(x: int) -> int:
+    return x * x
+
+
+def add(a, b):
+    return a + b
+
+
+def identity(x):
+    return x
+
+
+def random_error(x):
+    """Fails ~5% of the time — resilient-pool stress helper (reference:
+    tests/test_pool.py random_error_worker)."""
+    import random
+
+    if random.random() < 0.05:
+        raise ValueError("injected random failure")
+    return x

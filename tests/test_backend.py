@@ -1,0 +1,67 @@
+"""Backend registry / auto-selection (reference: tests/test_backend.py)."""
+
+import fiber_tpu  # noqa: F401  (package init)
+from fiber_tpu import backends
+from fiber_tpu.core import Backend, ProcessStatus, JobSpec
+
+
+def test_registry_identity():
+    a = backends.get_backend("local")
+    b = backends.get_backend("local")
+    assert a is b
+
+
+def test_auto_select_env(monkeypatch):
+    monkeypatch.setenv("FIBER_BACKEND", "local")
+    assert backends.auto_select_backend() == "local"
+
+
+def test_auto_select_tpu_sniff(monkeypatch):
+    from fiber_tpu import config
+
+    monkeypatch.delenv("FIBER_BACKEND", raising=False)
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    old = config.get().backend
+    config.get().update(backend="")
+    try:
+        assert backends.auto_select_backend() == "tpu"
+    finally:
+        config.get().update(backend=old)
+
+
+def test_local_backend_job_lifecycle():
+    import sys
+
+    backend = backends.get_backend("local")
+    spec = JobSpec(command=[sys.executable, "-c", "import time; time.sleep(0.3)"])
+    job = backend.create_job(spec)
+    assert backend.get_job_status(job) == ProcessStatus.STARTED
+    rc = backend.wait_for_job(job, 10)
+    assert rc == 0
+    assert backend.get_job_status(job) == ProcessStatus.STOPPED
+
+
+def test_local_backend_terminate():
+    import sys
+
+    backend = backends.get_backend("local")
+    spec = JobSpec(command=[sys.executable, "-c", "import time; time.sleep(60)"])
+    job = backend.create_job(spec)
+    backend.terminate_job(job)
+    rc = backend.wait_for_job(job, 10)
+    assert rc is not None and rc != 0
+
+
+def test_fault_injection_seam():
+    """The Backend interface is subclassable for fault injection (the
+    reference test suite's core mock pattern)."""
+
+    class Boom(Backend):
+        def create_job(self, job_spec):
+            raise TimeoutError("injected")
+
+    backend = Boom()
+    try:
+        backend.create_job(JobSpec(command=["true"]))
+    except TimeoutError as err:
+        assert str(err) == "injected"

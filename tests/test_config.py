@@ -1,0 +1,92 @@
+"""Config precedence + propagation (reference: tests/test_config.py)."""
+
+import os
+
+import pytest
+
+import fiber_tpu
+from fiber_tpu import config
+
+
+DEMO_CONF = "cpu_per_job = 4\nlog_level = DEBUG\n"
+
+
+def _write_conf(tmp_path, body, section=True):
+    path = tmp_path / "demo_config"
+    text = "[default]\n" + body if section else body
+    path.write_text(text)
+    return str(path)
+
+
+def test_defaults(monkeypatch):
+    monkeypatch.delenv("FIBER_BACKEND", raising=False)
+    cfg = config.Config(conf_file=None)
+    assert cfg.cpu_per_job == 1
+    assert cfg.ipc_active is True
+    assert cfg.backend == ""
+
+
+def test_file_layer(tmp_path):
+    cfg = config.Config(conf_file=_write_conf(tmp_path, DEMO_CONF))
+    assert cfg.cpu_per_job == 4
+    assert cfg.log_level == "DEBUG"
+
+
+def test_invalid_file_key(tmp_path):
+    path = _write_conf(tmp_path, "not_a_real_key = 1\n")
+    with pytest.raises(ValueError):
+        config.Config(conf_file=path)
+
+
+def test_env_overrides_file(tmp_path, monkeypatch):
+    path = _write_conf(tmp_path, DEMO_CONF)
+    monkeypatch.setenv("FIBER_CPU_PER_JOB", "8")
+    cfg = config.Config(conf_file=path)
+    assert cfg.cpu_per_job == 8
+
+
+def test_code_overrides_env(monkeypatch):
+    monkeypatch.setenv("FIBER_CPU_PER_JOB", "8")
+    cfg = config.Config(cpu_per_job=2)
+    assert cfg.cpu_per_job == 2
+
+
+def test_bool_coercion(monkeypatch):
+    monkeypatch.setenv("FIBER_IPC_ACTIVE", "false")
+    cfg = config.Config()
+    assert cfg.ipc_active is False
+    monkeypatch.setenv("FIBER_IPC_ACTIVE", "1")
+    assert config.Config().ipc_active is True
+
+
+def test_invalid_code_key():
+    with pytest.raises(ValueError):
+        config.Config(bogus_key=1)
+
+
+def test_init_from_roundtrip():
+    snapshot = config.Config(cpu_per_job=3, log_level="WARNING").as_dict()
+    cfg = config.init_from(snapshot)
+    try:
+        assert cfg.cpu_per_job == 3
+        assert cfg.log_level == "WARNING"
+        assert config.cpu_per_job == 3  # module-level attr proxy
+    finally:
+        config.init()
+
+
+def test_config_sync_to_child(tmp_path):
+    """Child sees the parent's resolved config (reference: test_config.py
+    test_config_sync)."""
+    from tests.targets import write_config_value
+
+    out = str(tmp_path / "out")
+    fiber_tpu.init(cpu_per_job=7)
+    try:
+        p = fiber_tpu.Process(target=write_config_value, args=(out, "cpu_per_job"))
+        p.start()
+        p.join(30)
+        assert p.exitcode == 0
+        assert open(out).read() == "7"
+    finally:
+        fiber_tpu.init()

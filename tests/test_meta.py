@@ -1,0 +1,36 @@
+"""@meta resource hints (reference: fiber/meta.py behavior)."""
+
+import pytest
+
+from fiber_tpu.meta import meta, get_meta
+
+
+def test_meta_attaches_hints():
+    @meta(cpu=4, memory=1024)
+    def fn():
+        pass
+
+    assert get_meta(fn) == {"cpu": 4, "mem": 1024}
+
+
+def test_meta_invalid_key():
+    with pytest.raises(ValueError):
+        meta(disk=100)
+
+
+def test_meta_stacking():
+    @meta(cpu=2)
+    @meta(gpu=1)
+    def fn():
+        pass
+
+    assert get_meta(fn) == {"cpu": 2, "gpu": 1}
+
+
+def test_meta_device_hint():
+    @meta(device=True)
+    def fn(x):
+        return x
+
+    assert get_meta(fn)["device"] is True
+    assert fn(3) == 3

@@ -1,0 +1,159 @@
+"""Process lifecycle (reference: tests/test_process.py)."""
+
+import select
+import threading
+import time
+
+import pytest
+
+import fiber_tpu
+from tests import targets
+
+
+def test_start_join_exitcode():
+    p = fiber_tpu.Process(target=targets.noop)
+    assert p.exitcode is None
+    p.start()
+    p.join(30)
+    assert p.exitcode == 0
+    assert not p.is_alive()
+
+
+def test_exit_code_propagates():
+    p = fiber_tpu.Process(target=targets.exit_with, args=(3,))
+    p.start()
+    p.join(30)
+    assert p.exitcode == 3
+
+
+def test_exception_gives_exitcode_1():
+    p = fiber_tpu.Process(target=targets.raise_error)
+    p.start()
+    p.join(30)
+    assert p.exitcode == 1
+
+
+def test_args_and_kwargs(tmp_path):
+    out = str(tmp_path / "out")
+    p = fiber_tpu.Process(
+        target=targets.write_file, args=(out,), kwargs={"content": "hello"}
+    )
+    p.start()
+    p.join(30)
+    assert open(out).read() == "hello"
+
+
+def test_is_alive_and_terminate():
+    p = fiber_tpu.Process(target=targets.sleep_forever)
+    p.start()
+    assert p.is_alive()
+    p.terminate()
+    p.join(30)
+    assert not p.is_alive()
+    assert p.exitcode is not None and p.exitcode != 0
+
+
+def test_pid_range():
+    """Pseudo-pids stay under 32768 (reference contract)."""
+    p = fiber_tpu.Process(target=targets.noop)
+    p.start()
+    assert p.pid is not None and 0 < p.pid < 32768
+    p.join(30)
+
+
+def test_sentinel_selectable():
+    p = fiber_tpu.Process(target=targets.sleep_for, args=(0.5,))
+    p.start()
+    fd = p.sentinel
+    readable, _, _ = select.select([fd], [], [], 30)
+    assert fd in readable
+    p.join(30)
+    assert p.exitcode == 0
+
+
+def test_active_children_tracking():
+    assert fiber_tpu.active_children() == []
+    p = fiber_tpu.Process(target=targets.sleep_for, args=(0.5,))
+    p.start()
+    assert p in fiber_tpu.active_children()
+    p.join(30)
+    assert p not in fiber_tpu.active_children()
+
+
+def test_child_process_name(tmp_path):
+    out = str(tmp_path / "out")
+    p = fiber_tpu.Process(
+        target=targets.write_process_name, args=(out,), name="NamedWorker"
+    )
+    p.start()
+    p.join(30)
+    assert open(out).read() == "NamedWorker"
+
+
+def test_daemon_flag():
+    p = fiber_tpu.Process(target=targets.noop, daemon=True)
+    assert p.daemon is True
+    p.daemon = False
+    p.start()
+    with pytest.raises(AssertionError):
+        p.daemon = True
+    p.join(30)
+
+
+def test_cannot_start_twice():
+    p = fiber_tpu.Process(target=targets.noop)
+    p.start()
+    with pytest.raises(AssertionError):
+        p.start()
+    p.join(30)
+
+
+def test_concurrent_starts_single_admin_thread():
+    """Exactly one admin accept-loop regardless of concurrent starts
+    (reference: tests/test_popen.py:70-94)."""
+    procs = [fiber_tpu.Process(target=targets.noop) for _ in range(5)]
+    threads = [threading.Thread(target=p.start) for p in procs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    admin_threads = [
+        t for t in threading.enumerate() if t.name == "fiber-admin"
+    ]
+    assert len(admin_threads) == 1
+    for p in procs:
+        p.join(30)
+        assert p.exitcode == 0
+
+
+def test_passive_ipc_mode():
+    """Master dials the worker (reference: tests/test_process.py:166-178)."""
+    fiber_tpu.init(ipc_active=False)
+    try:
+        p = fiber_tpu.Process(target=targets.noop)
+        p.start()
+        p.join(30)
+        assert p.exitcode == 0
+    finally:
+        fiber_tpu.init()
+
+
+def test_process_start_failure_surfaces_logs():
+    from fiber_tpu.backends import get_backend
+    from fiber_tpu.launcher import ProcessStartError
+    from fiber_tpu.core import Job, JobSpec
+
+    backend = get_backend("local")
+    orig = backend.create_job
+
+    def broken_create(spec: JobSpec):
+        spec = JobSpec(command=["python", "-c", "raise SystemExit(9)"])
+        return orig(spec)
+
+    backend.create_job = broken_create
+    try:
+        p = fiber_tpu.Process(target=targets.noop)
+        with pytest.raises(ProcessStartError):
+            p.start()
+    finally:
+        backend.create_job = orig

@@ -24,14 +24,17 @@ class ConnectionClosed(OSError):
     """Peer closed the connection mid-frame or before a frame."""
 
 
-def send_frame(sock: socket.socket, payload: bytes) -> None:
-    header = _LEN.pack(len(payload))
+def send_frame(sock: socket.socket, payload: bytes, prefix: bytes = b"") -> None:
+    """Send one frame; ``prefix`` rides inside the frame before the payload
+    (used by the transport for its 1-byte frame-type tag) without copying
+    large payloads."""
+    header = _LEN.pack(len(payload) + len(prefix))
     if len(payload) > 65536:
         # Avoid duplicating large payloads (host-plane tensors) in memory.
-        sock.sendall(header)
+        sock.sendall(header + prefix)
         sock.sendall(payload)
     else:
-        sock.sendall(header + payload)
+        sock.sendall(header + prefix + payload)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:

@@ -115,6 +115,9 @@ class JobLauncher:
             self.backend.terminate_job(self.job)
             raise
 
+        # Stamp the pseudo-pid before pickling so the worker's
+        # current_process().pid matches what the master sees.
+        process_obj._pid = self.pid
         prep = self._preparation_data(process_obj)
         send_frame(conn, serialization.dumps(prep))
         send_frame(conn, serialization.dumps(process_obj))

@@ -1,0 +1,903 @@
+"""Distributed pools: ``Pool`` and ``ResilientPool``.
+
+Reference parity: fiber/pool.py (ZPool / ResilientZPool — the reference's
+default). Architecture:
+
+* The master binds two transport endpoints: a **task stream** (push
+  round-robin for ``Pool``; REQ/REP handout for ``ResilientPool``) and a
+  **result stream** (pull, fair-merged).
+* Worker processes are fiber_tpu Processes started lazily on first use
+  (reference: fiber/pool.py:1118-1137) and maintained by a handler thread
+  that joins exited workers and repopulates (fiber/pool.py:975-1082).
+* Tasks are chunked (default 32 items — the reference's load-bearing
+  constant, fiber/pool.py:1169-1170); in-flight items are capped at 20,000
+  (explicit backpressure, fiber/pool.py:904) because the transport won't
+  block the way a full nanomsg socket would.
+* ``ResilientPool`` additionally keeps a per-worker pending table and
+  resubmits a dead worker's outstanding chunks (fiber/pool.py:1490-1659);
+  retry is only safe for idempotent task functions.
+
+TPU-native extension: a function marked ``@meta(device=True)`` short-cuts
+``map`` onto the on-device ``shard_map`` path (fiber_tpu/parallel) instead
+of the host worker path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import os
+import queue as pyqueue
+import threading
+import time
+import traceback
+import uuid
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from fiber_tpu import serialization
+from fiber_tpu.meta import get_meta
+from fiber_tpu.transport import Endpoint, TransportClosed
+from fiber_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+DEFAULT_CHUNKSIZE = 32
+MAX_INFLIGHT_TASKS = 20000
+
+_UNSET = object()
+
+
+class RemoteError(Exception):
+    """An exception raised inside a pool worker, with remote traceback."""
+
+    def __init__(self, exc: BaseException, tb: str) -> None:
+        super().__init__(str(exc))
+        self.original = exc
+        self.remote_traceback = tb
+
+    def __str__(self) -> str:
+        return f"{self.original!r}\n\nRemote traceback:\n{self.remote_traceback}"
+
+
+# ---------------------------------------------------------------------------
+# Result bookkeeping (reference: the Inventory, fiber/pool.py:644-728)
+# ---------------------------------------------------------------------------
+
+
+class _Entry:
+    __slots__ = ("values", "remaining", "total", "callbacks", "yielded")
+
+    def __init__(self, n: int) -> None:
+        self.values: List[Any] = [_UNSET] * n
+        self.remaining = n
+        self.total = n
+        self.callbacks: List[Callable] = []
+        self.yielded = 0
+
+
+class ResultStore:
+    """Sequence-keyed store of in-flight map results with ordered and
+    unordered iteration."""
+
+    def __init__(self) -> None:
+        self._entries: Dict[int, _Entry] = {}
+        self._seq = itertools.count()
+        self._cond = threading.Condition()
+        self._completion_log: Dict[int, List[int]] = {}
+
+    def add(self, n: int) -> int:
+        seq = next(self._seq)
+        with self._cond:
+            self._entries[seq] = _Entry(n)
+            self._completion_log[seq] = []
+        return seq
+
+    def fill(self, seq: int, base: int, values: List[Any]) -> None:
+        with self._cond:
+            entry = self._entries.get(seq)
+            if entry is None:
+                return
+            for offset, value in enumerate(values):
+                idx = base + offset
+                if entry.values[idx] is _UNSET:
+                    entry.values[idx] = value
+                    entry.remaining -= 1
+                    self._completion_log[seq].append(idx)
+            callbacks = list(entry.callbacks) if entry.remaining == 0 else []
+            self._cond.notify_all()
+        for cb in callbacks:
+            try:
+                cb()
+            except Exception:
+                logger.exception("pool callback failed")
+
+    def ready(self, seq: int) -> bool:
+        with self._cond:
+            entry = self._entries[seq]
+            return entry.remaining == 0
+
+    def wait(self, seq: int, timeout: Optional[float] = None) -> List[Any]:
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: self._entries[seq].remaining == 0, timeout
+            )
+            if not ok:
+                raise TimeoutError("pool result wait timed out")
+            return self._pop(seq)
+
+    def _pop(self, seq: int) -> List[Any]:
+        entry = self._entries.pop(seq)
+        self._completion_log.pop(seq, None)
+        return entry.values
+
+    def add_callback(self, seq: int, cb: Callable) -> None:
+        with self._cond:
+            entry = self._entries.get(seq)
+            if entry is None or entry.remaining == 0:
+                fire = True
+            else:
+                entry.callbacks.append(cb)
+                fire = False
+        if fire:
+            cb()
+
+    def iter_ordered(self, seq: int):
+        """Yield results in submission order as they become available."""
+        i = 0
+        while True:
+            with self._cond:
+                entry = self._entries.get(seq)
+                if entry is None:
+                    return
+                if i >= entry.total:
+                    self._pop(seq)
+                    return
+                self._cond.wait_for(
+                    lambda: self._entries[seq].values[i] is not _UNSET
+                )
+                value = self._entries[seq].values[i]
+            yield value
+            i += 1
+
+    def iter_unordered(self, seq: int):
+        """Yield results in completion order."""
+        yielded = 0
+        while True:
+            with self._cond:
+                entry = self._entries.get(seq)
+                if entry is None:
+                    return
+                if yielded >= entry.total:
+                    self._pop(seq)
+                    return
+                log = self._completion_log[seq]
+                self._cond.wait_for(
+                    lambda: len(self._completion_log[seq]) > yielded
+                )
+                idx = log[yielded]
+                value = entry.values[idx]
+            yield value
+            yielded += 1
+
+    def outstanding(self) -> int:
+        with self._cond:
+            return sum(e.remaining for e in self._entries.values())
+
+    def abort_all(self, exc: BaseException) -> None:
+        with self._cond:
+            for seq, entry in self._entries.items():
+                log = self._completion_log.get(seq, [])
+                for i, v in enumerate(entry.values):
+                    if v is _UNSET:
+                        entry.values[i] = _Failure(exc, "pool terminated")
+                        log.append(i)  # unblock iter_unordered consumers too
+                entry.remaining = 0
+            self._cond.notify_all()
+
+
+class _Failure:
+    """Marker wrapping a remote exception inside result slots."""
+
+    __slots__ = ("exc", "tb")
+
+    def __init__(self, exc: BaseException, tb: str) -> None:
+        self.exc = exc
+        self.tb = tb
+
+    def raise_(self) -> None:
+        raise RemoteError(self.exc, self.tb) from None
+
+
+def _resolve(value: Any) -> Any:
+    if isinstance(value, _Failure):
+        value.raise_()
+    return value
+
+
+class AsyncResult:
+    """Handle returned by apply_async (reference: fiber/pool.py:731-757)."""
+
+    def __init__(self, store: ResultStore, seq: int, single: bool) -> None:
+        self._store = store
+        self._seq = seq
+        self._single = single
+        self._value: Any = _UNSET
+        # Serializes concurrent fetches (user .get() vs. callback firing):
+        # the store entry can only be popped once.
+        self._fetch_lock = threading.Lock()
+
+    def _fetch(self, timeout: Optional[float]) -> None:
+        with self._fetch_lock:
+            if self._value is _UNSET:
+                self._value = self._store.wait(self._seq, timeout)
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        self._fetch(timeout)
+        if self._single:
+            return _resolve(self._value[0])
+        return [_resolve(v) for v in self._value]
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        try:
+            self._fetch(timeout)
+        except TimeoutError:
+            pass
+
+    def ready(self) -> bool:
+        return self._value is not _UNSET or self._store.ready(self._seq)
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result is not ready")
+        self._fetch(None)
+        values = self._value if not self._single else [self._value[0]]
+        return not any(isinstance(v, _Failure) for v in values)
+
+
+MapResult = AsyncResult
+
+
+class _ResultIterator:
+    """imap iterator: an item whose task raised re-raises RemoteError at
+    consumption, and the iterator remains usable for the items after it
+    (multiprocessing IMapIterator semantics)."""
+
+    def __init__(self, inner) -> None:
+        self._inner = inner
+
+    def __iter__(self) -> "_ResultIterator":
+        return self
+
+    def __next__(self) -> Any:
+        return _resolve(next(self._inner))
+
+
+# ---------------------------------------------------------------------------
+# Worker side
+# ---------------------------------------------------------------------------
+
+_EXIT = ("exit",)
+
+
+class _FuncCache:
+    """Unpickle each shipped function once per worker (functions travel as
+    bytes keyed by digest so repeated chunks are cheap)."""
+
+    def __init__(self) -> None:
+        self._cache: Dict[bytes, Callable] = {}
+
+    def get(self, digest: bytes, blob: Optional[bytes]) -> Callable:
+        fn = self._cache.get(digest)
+        if fn is None:
+            if blob is None:
+                raise RuntimeError("worker missing function blob")
+            fn = serialization.loads(blob)
+            self._cache[digest] = fn
+        return fn
+
+
+def _run_chunk(fn: Callable, chunk: List[Any], star: bool) -> List[Any]:
+    out: List[Any] = []
+    for args in chunk:
+        try:
+            if star:
+                out.append(fn(*args))
+            else:
+                out.append(fn(args))
+        except BaseException as exc:  # noqa: BLE001 - shipped to master
+            out.append(_Failure(exc, traceback.format_exc()))
+    return out
+
+
+def pool_worker(
+    task_addr: str,
+    result_addr: str,
+    resilient: bool,
+    initializer: Optional[Callable],
+    initargs: Tuple,
+    maxtasksperchild: Optional[int],
+    n_local: int = 1,
+) -> None:
+    """Body of one pool worker process. With ``n_local > 1`` the process
+    packs that many OS sub-workers, each dialing the master independently
+    (reference: fiber/pool.py:144-173 cpu_per_job packing)."""
+    if n_local > 1:
+        import multiprocessing
+
+        ctx = multiprocessing.get_context("fork")
+        children = [
+            ctx.Process(
+                target=_pool_worker_core,
+                args=(task_addr, result_addr, resilient, initializer,
+                      initargs, maxtasksperchild),
+                name=f"fiber-subworker-{i}",
+                daemon=True,
+            )
+            for i in range(n_local)
+        ]
+        for c in children:
+            c.start()
+        for c in children:
+            c.join()
+        return
+    _pool_worker_core(
+        task_addr, result_addr, resilient, initializer, initargs,
+        maxtasksperchild,
+    )
+
+
+def _pool_worker_core(
+    task_addr: str,
+    result_addr: str,
+    resilient: bool,
+    initializer: Optional[Callable],
+    initargs: Tuple,
+    maxtasksperchild: Optional[int],
+) -> None:
+    from fiber_tpu import process as fprocess
+
+    if initializer is not None:
+        initializer(*initargs)
+
+    ident = uuid.uuid4().bytes
+    fiber_pid = fprocess.current_process().pid or os.getpid()
+    funcs = _FuncCache()
+
+    result_ep = Endpoint("w").connect(result_addr)
+    if resilient:
+        task_ep = Endpoint("req").connect(task_addr)
+    else:
+        task_ep = Endpoint("r").connect(task_addr)
+
+    completed_chunks = 0
+    try:
+        while True:
+            if resilient:
+                task_ep.send(serialization.dumps(("ready", ident, fiber_pid)))
+                data = task_ep.recv()
+            else:
+                data = task_ep.recv()
+            msg = serialization.loads(data)
+            if msg[0] == "exit":
+                break
+            _, seq, base, digest, blob, chunk, star = msg
+            fn = funcs.get(digest, blob)
+            values = _run_chunk(fn, chunk, star)
+            result_ep.send(
+                serialization.dumps(("result", seq, base, values, ident))
+            )
+            completed_chunks += 1
+            if maxtasksperchild and completed_chunks >= maxtasksperchild:
+                break
+    except (TransportClosed, OSError):
+        pass  # master went away; the watchdog handles hard exits
+    finally:
+        task_ep.close()
+        result_ep.close()
+
+
+# ---------------------------------------------------------------------------
+# Master side
+# ---------------------------------------------------------------------------
+
+
+class Pool:
+    """Round-robin push pool (reference ZPool, fiber/pool.py:881-1422)."""
+
+    _resilient = False
+
+    def __init__(
+        self,
+        processes: Optional[int] = None,
+        initializer: Optional[Callable] = None,
+        initargs: Tuple = (),
+        maxtasksperchild: Optional[int] = None,
+    ) -> None:
+        from fiber_tpu import config
+        from fiber_tpu.backends import get_backend
+
+        cfg = config.get()
+        if processes is None:
+            processes = os.cpu_count() or 4
+        if processes < 1:
+            raise ValueError("processes must be >= 1")
+        self._n_workers = processes
+        self._initializer = initializer
+        self._initargs = initargs
+        self._maxtasksperchild = maxtasksperchild
+        self._cpu_per_job = max(1, int(cfg.cpu_per_job))
+        # Number of fiber processes (jobs): workers are packed
+        # cpu_per_job-per-job (reference: fiber/pool.py:1009-1057).
+        self._n_jobs = (processes + self._cpu_per_job - 1) // self._cpu_per_job
+
+        ip, _, _ = get_backend().get_listen_addr()
+        self._task_ep = Endpoint("rep" if self._resilient else "w")
+        self._task_addr = self._task_ep.bind(ip)
+        self._result_ep = Endpoint("r")
+        self._result_addr = self._result_ep.bind(ip)
+
+        self._store = ResultStore()
+        self._taskq: "pyqueue.Queue" = pyqueue.Queue()
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+
+        self._workers: List = []
+        self._workers_lock = threading.Lock()
+        self._closed = False
+        self._terminated = False
+        self._workers_started = False
+        self._pool_meta: Optional[Dict[str, Any]] = None
+
+        self._result_thread = threading.Thread(
+            target=self._result_loop, name="fiber-pool-results", daemon=True
+        )
+        self._result_thread.start()
+        self._task_thread = threading.Thread(
+            target=self._task_loop, name="fiber-pool-tasks", daemon=True
+        )
+        self._task_thread.start()
+        self._worker_thread: Optional[threading.Thread] = None
+
+    # -- worker management (lazy) -----------------------------------------
+    def _ensure_workers(self, func: Callable) -> None:
+        hints = {
+            k: v for k, v in get_meta(func).items() if k in ("cpu", "mem", "gpu")
+        }
+        if self._pool_meta is None:
+            self._pool_meta = hints
+        elif hints and hints != self._pool_meta:
+            raise ValueError(
+                "all functions used with one Pool must share resource meta "
+                f"(pool started with {self._pool_meta}, got {hints})"
+            )
+        if self._workers_started:
+            return
+        self._workers_started = True
+        self._worker_thread = threading.Thread(
+            target=self._worker_loop, name="fiber-pool-workers", daemon=True
+        )
+        self._worker_thread.start()
+
+    def _spawn_worker(self):
+        from fiber_tpu.process import Process
+
+        n_local = min(self._cpu_per_job, self._n_workers)
+        p = Process(
+            target=pool_worker,
+            args=(
+                self._task_addr,
+                self._result_addr,
+                self._resilient,
+                self._initializer,
+                self._initargs,
+                self._maxtasksperchild,
+                n_local,
+            ),
+            name=f"PoolWorker-{uuid.uuid4().hex[:8]}",
+            daemon=True,
+        )
+        try:
+            p.start()
+            return p
+        except Exception:
+            logger.warning("pool worker start failed; will retry",
+                           exc_info=True)
+            return None
+
+    def _worker_loop(self) -> None:
+        """Maintain the worker population; reap the dead, start missing
+        (reference: fiber/pool.py:975-1082). Keeps running through a
+        close() drain so deaths mid-drain are still repaired."""
+        while not self._terminated and (
+            not self._closed or self._store.outstanding() > 0
+        ):
+            self._maintain_workers()
+            time.sleep(0.2)
+
+    def _maintain_workers(self) -> None:
+        with self._workers_lock:
+            dead = [p for p in self._workers if p is not None and not p.is_alive()]
+            for p in dead:
+                self._workers.remove(p)
+                self._on_worker_death(p)
+            missing = self._n_jobs - len(self._workers)
+        for _ in range(missing):
+            if self._terminated or self._closed:
+                return
+            p = self._spawn_worker()
+            if p is not None:
+                with self._workers_lock:
+                    self._workers.append(p)
+
+    def _on_worker_death(self, proc) -> None:
+        logger.debug("pool worker %s died", proc.name)
+
+    # -- task egress -------------------------------------------------------
+    def _task_loop(self) -> None:
+        """Move tasks from the local queue onto the wire with explicit
+        flow control (reference hot loop: fiber/pool.py:952-963)."""
+        while True:
+            item = self._taskq.get()
+            if item is None:
+                return
+            payload, nitems = item
+            while self._store.outstanding() > MAX_INFLIGHT_TASKS:
+                if self._terminated:
+                    return
+                time.sleep(0.01)
+            while True:
+                if self._terminated:
+                    return
+                try:
+                    self._task_ep.send(payload, timeout=1.0)
+                    break
+                except TimeoutError:
+                    continue
+                except (TransportClosed, OSError):
+                    return
+
+    def _result_loop(self) -> None:
+        while True:
+            try:
+                data = self._result_ep.recv()
+            except (TransportClosed, OSError):
+                return
+            msg = serialization.loads(data)
+            if msg[0] != "result":
+                continue
+            _, seq, base, values, ident = msg
+            self._on_result(seq, base, values, ident)
+            self._store.fill(seq, base, values)
+
+    def _on_result(self, seq, base, values, ident) -> None:
+        pass
+
+    # -- submission --------------------------------------------------------
+    def _submit(
+        self,
+        func: Callable,
+        iterable: Iterable[Any],
+        chunksize: Optional[int],
+        star: bool,
+        callback: Optional[Callable] = None,
+        error_callback: Optional[Callable] = None,
+        single: bool = False,
+    ) -> AsyncResult:
+        if self._closed or self._terminated:
+            raise ValueError("Pool not running")
+        self._ensure_workers(func)
+        items = list(iterable)
+        seq = self._store.add(len(items))
+        result = AsyncResult(self._store, seq, single=single)
+        if callback is not None or error_callback is not None:
+
+            def fire() -> None:
+                try:
+                    value = result.get(0)
+                except RemoteError as err:
+                    if error_callback is not None:
+                        error_callback(err)
+                    return
+                except Exception:
+                    return
+                if callback is not None:
+                    callback(value)
+
+            self._store.add_callback(seq, fire)
+        if not items:
+            return result
+        if chunksize is None:
+            chunksize = max(1, min(DEFAULT_CHUNKSIZE,
+                                   len(items) // (self._n_workers * 4) or 1))
+        blob = serialization.dumps(func)
+        digest = hashlib.md5(blob).digest()
+        for base in range(0, len(items), chunksize):
+            chunk = items[base:base + chunksize]
+            payload = serialization.dumps(
+                ("task", seq, base, digest, blob, chunk, star)
+            )
+            self._taskq.put((payload, len(chunk)))
+        return result
+
+    # -- public API --------------------------------------------------------
+    def apply(self, func: Callable, args: Tuple = (), kwds: Optional[Dict] = None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(
+        self,
+        func: Callable,
+        args: Tuple = (),
+        kwds: Optional[Dict] = None,
+        callback: Optional[Callable] = None,
+        error_callback: Optional[Callable] = None,
+    ) -> AsyncResult:
+        if kwds:
+            import functools
+
+            func = functools.partial(func, **kwds)
+        return self._submit(func, [tuple(args)], 1, True,
+                            callback, error_callback, single=True)
+
+    def map(
+        self,
+        func: Callable,
+        iterable: Iterable[Any],
+        chunksize: Optional[int] = None,
+    ) -> List[Any]:
+        if get_meta(func).get("device"):
+            try:
+                from fiber_tpu.parallel import device_map
+            except ImportError as err:  # pragma: no cover
+                raise RuntimeError(
+                    "@meta(device=True) requires the fiber_tpu.parallel "
+                    "device path"
+                ) from err
+            return device_map(func, iterable)
+        return self.map_async(func, iterable, chunksize).get()
+
+    def map_async(
+        self,
+        func: Callable,
+        iterable: Iterable[Any],
+        chunksize: Optional[int] = None,
+        callback: Optional[Callable] = None,
+        error_callback: Optional[Callable] = None,
+    ) -> AsyncResult:
+        return self._submit(func, iterable, chunksize, False,
+                            callback, error_callback)
+
+    def starmap(
+        self,
+        func: Callable,
+        iterable: Iterable[Tuple],
+        chunksize: Optional[int] = None,
+    ) -> List[Any]:
+        return self.starmap_async(func, iterable, chunksize).get()
+
+    def starmap_async(
+        self,
+        func: Callable,
+        iterable: Iterable[Tuple],
+        chunksize: Optional[int] = None,
+        callback: Optional[Callable] = None,
+        error_callback: Optional[Callable] = None,
+    ) -> AsyncResult:
+        return self._submit(func, [tuple(t) for t in iterable], chunksize,
+                            True, callback, error_callback)
+
+    def imap(
+        self,
+        func: Callable,
+        iterable: Iterable[Any],
+        chunksize: Optional[int] = None,
+    ) -> "_ResultIterator":
+        res = self._submit(func, iterable, chunksize, False)
+        return _ResultIterator(self._store.iter_ordered(res._seq))
+
+    def imap_unordered(
+        self,
+        func: Callable,
+        iterable: Iterable[Any],
+        chunksize: Optional[int] = None,
+    ) -> "_ResultIterator":
+        res = self._submit(func, iterable, chunksize, False)
+        return _ResultIterator(self._store.iter_unordered(res._seq))
+
+    # -- lifecycle ---------------------------------------------------------
+    def wait_workers(self, n: Optional[int] = None,
+                     timeout: Optional[float] = None) -> bool:
+        """Block until n (default: all) worker connections are up
+        (reference: fiber/pool.py:1405-1422)."""
+        n = n if n is not None else self._n_workers
+        return self._result_ep.wait_for_peers(n, timeout)
+
+    def close(self) -> None:
+        """No new tasks; workers exit once submitted work drains (the
+        release itself happens in join(), deterministically)."""
+        self._closed = True
+
+    def _release_workers(self) -> None:
+        """Send one exit message per connected task consumer; strict
+        round-robin delivers exactly one to each."""
+        exit_payload = serialization.dumps(_EXIT)
+        for _ in range(self._task_ep.peer_count()):
+            try:
+                self._task_ep.send(exit_payload, timeout=5.0)
+            except (TimeoutError, TransportClosed, OSError):
+                break
+
+    def join(self) -> None:
+        if not self._closed and not self._terminated:
+            raise ValueError("join() before close()/terminate()")
+        # 1. Drain all submitted work.
+        while self._store.outstanding() > 0 and not self._terminated:
+            time.sleep(0.05)
+        # 2. Stop the maintainer so the worker list can no longer change.
+        if self._worker_thread is not None:
+            self._worker_thread.join(60)
+        # 3. Release and reap the workers.
+        if not self._terminated and not self._resilient:
+            self._release_workers()
+        with self._workers_lock:
+            workers = list(self._workers)
+        for p in workers:
+            p.join(10)
+            if p.is_alive():
+                logger.warning("pool worker %s did not exit; terminating",
+                               p.name)
+                p.terminate()
+                p.join(10)
+        with self._workers_lock:
+            self._workers = []
+        self._shutdown_transport()
+
+    def terminate(self) -> None:
+        self._terminated = True
+        self._closed = True
+        with self._workers_lock:
+            workers = list(self._workers)
+        for p in workers:
+            try:
+                p.terminate()
+            except Exception:
+                pass
+        for p in workers:
+            try:
+                p.join(10)
+            except Exception:
+                pass
+        with self._workers_lock:
+            self._workers = []
+        self._store.abort_all(RuntimeError("pool terminated"))
+        self._shutdown_transport()
+
+    def _shutdown_transport(self) -> None:
+        self._taskq.put(None)
+        self._task_ep.close()
+        self._result_ep.close()
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        if not self._closed:
+            self.close()
+        self.join()
+
+    def __del__(self) -> None:  # pragma: no cover - GC safety net
+        try:
+            if not self._terminated and not self._closed:
+                self.terminate()
+        except Exception:
+            pass
+
+
+class ResilientPool(Pool):
+    """REQ/REP pool with a pending table and resubmission on worker death
+    (reference ResilientZPool, fiber/pool.py:1425-1688) — the default
+    ``fiber_tpu.Pool``. Only safe for idempotent task functions."""
+
+    _resilient = True
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        # ident -> {(seq, base): (payload, nitems)}
+        self._pending: Dict[bytes, Dict[Tuple[int, int], Tuple[bytes, int]]] = {}
+        self._pid_to_idents: Dict[int, set] = {}
+        self._reaped_pids: set = set()
+        self._pending_lock = threading.Lock()
+        super().__init__(*args, **kwargs)
+
+    # Task handout: answer each worker's "ready" request with a task and
+    # record it in the pending table until its result arrives.
+    def _task_loop(self) -> None:
+        # Runs until the pool's transport shuts down (join/terminate close
+        # the endpoints → recv raises). During a close() drain it keeps
+        # answering "ready" requests — with remaining tasks first, then
+        # with exit messages so every worker is released.
+        while True:
+            try:
+                req = self._task_ep.recv(timeout=0.5)
+            except TimeoutError:
+                if self._terminated:
+                    return
+                continue
+            except (TransportClosed, OSError):
+                return
+            msg = serialization.loads(req)
+            if msg[0] != "ready":
+                continue
+            _, ident, fiber_pid = msg
+            # A stale "ready" from a worker that was already reaped must
+            # not receive (and thereby strand) a task: its pending table is
+            # gone and nobody would ever resubmit the chunk.
+            with self._pending_lock:
+                stale = fiber_pid in self._reaped_pids
+            if stale:
+                try:
+                    self._task_ep.send(serialization.dumps(_EXIT))
+                except (TransportClosed, OSError):
+                    pass
+                continue
+            with self._pending_lock:
+                self._pending.setdefault(ident, {})
+                self._pid_to_idents.setdefault(fiber_pid, set()).add(ident)
+            item = None
+            while item is None:
+                if self._terminated:
+                    return
+                if self._closed and self._store.outstanding() == 0 and \
+                        self._taskq.empty():
+                    try:
+                        self._task_ep.send(serialization.dumps(_EXIT),
+                                           timeout=5.0)
+                    except (TimeoutError, TransportClosed, OSError):
+                        pass
+                    break
+                try:
+                    item = self._taskq.get(timeout=0.5)
+                except pyqueue.Empty:
+                    continue
+                if item is None:
+                    return
+            if item is None:
+                continue
+            payload, nitems = item
+            head = serialization.loads(payload)
+            key = (head[1], head[2])  # (seq, base)
+            with self._pending_lock:
+                self._pending[ident][key] = (payload, nitems)
+            try:
+                self._task_ep.send(payload)
+            except (TransportClosed, OSError):
+                # Requester died between asking and receiving; put the
+                # chunk back for the next "ready" and keep serving.
+                with self._pending_lock:
+                    self._pending[ident].pop(key, None)
+                self._taskq.put((payload, nitems))
+                continue
+
+    def _on_result(self, seq, base, values, ident) -> None:
+        with self._pending_lock:
+            table = self._pending.get(ident)
+            if table is not None:
+                table.pop((seq, base), None)
+
+    def _on_worker_death(self, proc) -> None:
+        """Resubmit everything the dead worker still owed
+        (reference: fiber/pool.py:1612-1659)."""
+        pid = proc.pid
+        with self._pending_lock:
+            self._reaped_pids.add(pid)
+            idents = self._pid_to_idents.pop(pid, set())
+            resubmit: List[Tuple[bytes, int]] = []
+            for ident in idents:
+                table = self._pending.pop(ident, {})
+                resubmit.extend(table.values())
+        for payload, nitems in resubmit:
+            self._taskq.put((payload, nitems))
+        if resubmit:
+            logger.info(
+                "resubmitted %d chunks from dead worker %s",
+                len(resubmit), proc.name,
+            )
+

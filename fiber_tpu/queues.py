@@ -1,0 +1,215 @@
+"""Distributed Pipes and Queues over the host-plane transport.
+
+Reference parity: fiber/queues.py. The key property (ZConnection
+semantics, queues.py:86-249 in the reference): connection objects are
+**picklable** — they serialize to (mode, address) and lazily re-dial the
+device after deserialization in another process, so queues/pipes can be
+passed freely as Process args, through other queues, or into plain
+multiprocessing children.
+
+Every queue/pipe is anchored by a ``Device`` forwarder in the creating
+process, giving both ends a stable address to dial (reference:
+fiber/queues.py:15-23 design note).
+"""
+
+from __future__ import annotations
+
+import queue as pyqueue
+import threading
+from typing import Any, Optional, Tuple
+
+from fiber_tpu import serialization
+from fiber_tpu.transport import Device, Endpoint, TransportClosed
+from fiber_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+
+def _listen_ip() -> str:
+    from fiber_tpu.backends import get_backend
+
+    ip, _, _ = get_backend().get_listen_addr()
+    return ip
+
+
+class Connection:
+    """A picklable, lazily-connecting message connection.
+
+    API mirrors ``multiprocessing.connection.Connection``: send/recv
+    (pickled objects), send_bytes/recv_bytes, poll, fileno, close.
+    """
+
+    def __init__(self, mode: str, addr: str) -> None:
+        self._mode = mode
+        self._addr = addr
+        self._ep: Optional[Endpoint] = None
+        self._lock = threading.Lock()
+
+    # -- wiring -----------------------------------------------------------
+    def _endpoint(self) -> Endpoint:
+        if self._ep is None:
+            with self._lock:
+                if self._ep is None:
+                    ep = Endpoint(self._mode)
+                    ep.connect(self._addr)
+                    self._ep = ep
+        return self._ep
+
+    # -- data -------------------------------------------------------------
+    def send_bytes(self, payload: bytes) -> None:
+        self._endpoint().send(payload)
+
+    def recv_bytes(self, timeout: Optional[float] = None) -> bytes:
+        return self._endpoint().recv(timeout)
+
+    def send(self, obj: Any) -> None:
+        self.send_bytes(serialization.dumps(obj))
+
+    def recv(self, timeout: Optional[float] = None) -> Any:
+        return serialization.loads(self.recv_bytes(timeout))
+
+    def poll(self, timeout: Optional[float] = 0.0) -> bool:
+        return self._endpoint().poll(timeout)
+
+    def fileno(self) -> int:
+        return self._endpoint().fileno()
+
+    def close(self) -> None:
+        if self._ep is not None:
+            self._ep.close()
+            self._ep = None
+        # Creator-side ends co-own the anchoring device: when the last
+        # locally-created end closes, the device (listeners + pump threads)
+        # is released too. Unpickled remote copies never carry _device and
+        # never tear the pipe down.
+        device_ref = getattr(self, "_device_ref", None)
+        if device_ref is not None:
+            self._device_ref = None
+            device_ref.release()
+
+    # -- pickling ---------------------------------------------------------
+    def __getstate__(self) -> Tuple[str, str]:
+        return (self._mode, self._addr)
+
+    def __setstate__(self, state: Tuple[str, str]) -> None:
+        self._mode, self._addr = state
+        self._ep = None
+        self._lock = threading.Lock()
+
+    def __repr__(self) -> str:
+        return f"Connection(mode={self._mode!r}, addr={self._addr!r})"
+
+
+class _DeviceRef:
+    """Refcount so a device closes when the last creator-side user of it
+    is closed."""
+
+    def __init__(self, device: Device, count: int) -> None:
+        self._device = device
+        self._count = count
+        self._lock = threading.Lock()
+
+    def release(self) -> None:
+        with self._lock:
+            self._count -= 1
+            if self._count > 0:
+                return
+        self._device.close()
+
+
+def Pipe(duplex: bool = True) -> Tuple[Connection, Connection]:
+    """A pipe whose two ends are picklable and machine-portable
+    (reference: fiber/queues.py:262-281).
+
+    duplex=True: both ends send and receive. duplex=False: returns
+    (receive_end, send_end) like multiprocessing.
+    """
+    ip = _listen_ip()
+    if duplex:
+        device = Device("rw", "rw", ip)
+        c1 = Connection("rw", device.in_addr)
+        c2 = Connection("rw", device.out_addr)
+    else:
+        device = Device("r", "w", ip)
+        c1 = Connection("r", device.out_addr)   # receive end
+        c2 = Connection("w", device.in_addr)    # send end
+    # Anchor the device in the creating process; it dies when both
+    # creator-side ends are closed (or with the process).
+    ref = _DeviceRef(device, 2)
+    c1._device_ref = ref  # type: ignore[attr-defined]
+    c2._device_ref = ref  # type: ignore[attr-defined]
+    return c1, c2
+
+
+class SimpleQueue:
+    """Multi-producer multi-consumer distributed queue.
+
+    Producers PUSH to the device's in-address; the device PUSHes to
+    consumers **round-robin** (the load-balancing contract of the
+    reference's push queue, fiber/queues.py:284-352, tested for exact
+    fairness by the reference suite).
+    """
+
+    def __init__(self) -> None:
+        ip = _listen_ip()
+        self._device: Optional[Device] = Device("r", "w", ip)
+        self._in_addr = self._device.in_addr
+        self._out_addr = self._device.out_addr
+        self._writer: Optional[Connection] = None
+        self._reader: Optional[Connection] = None
+
+    # -- lazy per-process connections -------------------------------------
+    def _get_writer(self) -> Connection:
+        if self._writer is None:
+            self._writer = Connection("w", self._in_addr)
+        return self._writer
+
+    def _get_reader(self) -> Connection:
+        if self._reader is None:
+            self._reader = Connection("r", self._out_addr)
+        return self._reader
+
+    # -- queue API --------------------------------------------------------
+    def put(self, obj: Any) -> None:
+        self._get_writer().send(obj)
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        try:
+            return self._get_reader().recv(timeout)
+        except TimeoutError:
+            raise pyqueue.Empty from None
+
+    def empty(self) -> bool:
+        """Approximate: True if no message is locally available."""
+        return not self._get_reader().poll(0.0)
+
+    def wait_consumers(self, n: int, timeout: Optional[float] = None) -> bool:
+        """Block until n consumers have dialed in (only callable in the
+        creating process; used to make round-robin fan-out exact)."""
+        if self._device is None:
+            raise ValueError("wait_consumers: not the creating process")
+        return self._device.out_ep.wait_for_peers(n, timeout)
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        if self._reader is not None:
+            self._reader.close()
+            self._reader = None
+        if self._device is not None:
+            self._device.close()
+            self._device = None
+
+    # -- pickling ---------------------------------------------------------
+    def __getstate__(self):
+        return (self._in_addr, self._out_addr)
+
+    def __setstate__(self, state) -> None:
+        self._in_addr, self._out_addr = state
+        self._device = None
+        self._writer = None
+        self._reader = None
+
+    def __repr__(self) -> str:
+        return f"SimpleQueue(in={self._in_addr!r}, out={self._out_addr!r})"

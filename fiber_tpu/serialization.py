@@ -55,15 +55,15 @@ def register_jax_reducers() -> None:
 
     if "jax" not in sys.modules:
         return
-    import jax
-
-    ForkingPickler.register(jax.Array, _jax_array_reduce)
+    # Pickle dispatch is exact-type, so the concrete ArrayImpl class must
+    # be registered (not the jax.Array ABC). Import it without creating an
+    # array: materializing even a scalar would initialize the TPU runtime
+    # from whatever process happens to pickle first.
     try:
-        # Concrete array class may differ from the jax.Array ABC.
-        concrete = type(jax.numpy.zeros(()))
-        ForkingPickler.register(concrete, _jax_array_reduce)
-    except Exception:
-        pass
+        from jax._src.array import ArrayImpl
+    except ImportError:  # pragma: no cover - jax internals moved
+        return
+    ForkingPickler.register(ArrayImpl, _jax_array_reduce)
     _jax_reducer_registered = True
 
 

@@ -1,0 +1,17 @@
+"""Host-plane transport: framed-TCP endpoints + device forwarders.
+
+Semantics preserved from the reference's nanomsg data plane
+(fiber/socket.py) without the library zoo:
+
+* modes ``r`` (pull), ``w`` (push, strict round-robin over connected
+  peers), ``rw`` (pair-ish duplex), ``req``/``rep`` (resilient task
+  handout);
+* a ``Device`` is a forwarder bound to stable addresses so both producers
+  and consumers dial *it* (reference: fiber/socket.py:297-320 nn_device);
+* random bind ports in 40000-65535.
+
+The pump loop runs in Python threads by default and in the C++ epoll pump
+(fiber_tpu/_native) when built — same observable behavior.
+"""
+
+from fiber_tpu.transport.tcp import Device, Endpoint, TransportClosed  # noqa: F401

@@ -1,0 +1,456 @@
+"""TCP implementation of the host-plane transport.
+
+An ``Endpoint`` is a message socket with one of five modes:
+
+====  =========================================================
+r     receive-only; fair-merges frames from all connected peers
+w     send-only; strict round-robin across connected peers
+rw    duplex; round-robin send + fair-merge receive
+req   client of a rep endpoint: send a request, recv the answer
+rep   server: recv returns a request; the next send answers it
+====  =========================================================
+
+A bound endpoint accepts any number of dialing peers. Fairness contracts
+(tested, mirroring the reference's nanomsg behavior): ``w``-send
+round-robins message-by-message across peers regardless of consumer speed;
+``r``-recv merges arrival order across peers.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import socket as pysocket
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from fiber_tpu.framing import (
+    ConnectionClosed,
+    recv_frame,
+    send_frame,
+)
+from fiber_tpu.utils.logging import get_logger
+from fiber_tpu.utils.net import random_port_bind
+
+logger = get_logger()
+
+MODES = ("r", "w", "rw", "req", "rep")
+
+_SENTINEL = object()
+
+# Transport frame types (first payload byte). Only the w→r push pattern
+# uses credits; rw/req/rep frames are always DATA.
+_T_DATA = b"\x00"
+_T_CREDIT = b"\x01"
+_CREDIT = struct.Struct(">I")
+
+#: Standing credit window granted per peer by bound r-endpoints (fan-in
+#: ingress like pool result streams): large enough to never throttle, small
+#: enough to bound memory.
+DEFAULT_CREDIT_WINDOW = 4096
+
+
+class TransportClosed(OSError):
+    pass
+
+
+class _Inbox:
+    """FIFO of (channel, frame) with blocking get and a true (non-consuming)
+    peek, so poll() can never reorder frames."""
+
+    def __init__(self) -> None:
+        self._items: "collections.deque" = collections.deque()
+        self._cond = threading.Condition()
+
+    def put(self, item) -> None:
+        with self._cond:
+            self._items.append(item)
+            self._cond.notify_all()
+
+    def get(self, timeout: Optional[float] = None):
+        with self._cond:
+            if not self._cond.wait_for(lambda: len(self._items) > 0, timeout):
+                return _SENTINEL_EMPTY
+            return self._items.popleft()
+
+    def peek(self, timeout: Optional[float] = None):
+        """Return the head item without removing it (or _SENTINEL_EMPTY)."""
+        with self._cond:
+            if not self._cond.wait_for(lambda: len(self._items) > 0, timeout):
+                return _SENTINEL_EMPTY
+            return self._items[0]
+
+    def empty(self) -> bool:
+        return not self._items
+
+    def qsize(self) -> int:
+        return len(self._items)
+
+
+_SENTINEL_EMPTY = object()
+
+
+class _Channel:
+    """One TCP connection plus its reader thread."""
+
+    _ids = itertools.count()
+
+    def __init__(self, sock: pysocket.socket, owner: "Endpoint") -> None:
+        self.sock = sock
+        self.owner = owner
+        self.cid = next(self._ids)
+        self.alive = True
+        self.credit = 0  # how many frames the peer is ready to accept
+        self._send_lock = threading.Lock()
+        sock.setsockopt(pysocket.IPPROTO_TCP, pysocket.TCP_NODELAY, 1)
+        self._reader: Optional[threading.Thread] = None
+
+    def start_reader(self) -> None:
+        self._reader = threading.Thread(
+            target=self._read_loop,
+            name=f"fiber-chan-{self.cid}",
+            daemon=True,
+        )
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = recv_frame(self.sock)
+                kind = frame[:1]
+                if kind == _T_CREDIT:
+                    (n,) = _CREDIT.unpack(frame[1:5])
+                    with self.owner._chan_lock:
+                        self.credit += n
+                        self.owner._chan_lock.notify_all()
+                else:
+                    self.owner._inbox.put((self, frame[1:]))
+        except (ConnectionClosed, OSError):
+            pass
+        finally:
+            self.owner._drop_channel(self)
+
+    def send(self, payload: bytes) -> None:
+        with self._send_lock:
+            send_frame(self.sock, payload, prefix=_T_DATA)
+
+    def send_credit(self, n: int) -> None:
+        with self._send_lock:
+            send_frame(self.sock, _T_CREDIT + _CREDIT.pack(n))
+
+    def close(self) -> None:
+        self.alive = False
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class Endpoint:
+    def __init__(self, mode: str) -> None:
+        if mode not in MODES:
+            raise ValueError(f"invalid endpoint mode {mode!r}")
+        self.mode = mode
+        self._inbox = _Inbox()
+        self._channels: List[_Channel] = []
+        self._chan_lock = threading.Condition()
+        self._rr = 0
+        self._listener: Optional[pysocket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._reply_to: Optional[_Channel] = None
+        self.addr: Optional[str] = None
+        self._is_bound = False
+        # Demand-driven credit state for *connected* r-endpoints (queue
+        # consumers): credit is granted only when a reader actually blocks
+        # in recv(), so undelivered frames stay in the upstream device
+        # instead of a dead consumer's socket buffer.
+        self._credit_outstanding = 0
+        self._waiting_readers = 0
+        self._recv_lock = threading.Lock()
+
+    # -- wiring -----------------------------------------------------------
+    def bind(self, ip: str, port: int = 0) -> str:
+        """Listen and return the advertised address ``tcp://ip:port``."""
+        listener = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_STREAM)
+        listener.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_REUSEADDR, 1)
+        if port:
+            listener.bind(("", port))
+        else:
+            _, port = random_port_bind(listener)
+        listener.listen(512)
+        self._listener = listener
+        self._is_bound = True
+        self.addr = f"tcp://{ip}:{port}"
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="fiber-ep-accept", daemon=True
+        )
+        self._accept_thread.start()
+        return self.addr
+
+    def connect(self, addr: str) -> "Endpoint":
+        host, port = parse_addr(addr)
+        sock = pysocket.create_connection((host, port), timeout=30.0)
+        sock.settimeout(None)
+        self.addr = addr
+        self._add_channel(sock)
+        return self
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            self._add_channel(sock)
+
+    def _add_channel(self, sock: pysocket.socket) -> None:
+        chan = _Channel(sock, self)
+        with self._chan_lock:
+            self._channels.append(chan)
+            self._chan_lock.notify_all()
+        # Every channel gets a reader: data/credit frames for receiving
+        # modes, EOF detection for send-only ones.
+        chan.start_reader()
+        if self.mode == "r" and self._is_bound:
+            # Fan-in ingress (e.g. pool result streams): standing credit
+            # window per peer, replenished as frames are consumed.
+            try:
+                chan.send_credit(DEFAULT_CREDIT_WINDOW)
+            except OSError:
+                pass
+
+    def _drop_channel(self, chan: _Channel) -> None:
+        chan.alive = False
+        with self._chan_lock:
+            if chan in self._channels:
+                self._channels.remove(chan)
+        chan.close()
+
+    # -- data path --------------------------------------------------------
+    def send(self, payload: bytes, timeout: Optional[float] = None) -> None:
+        if self.mode == "r":
+            raise TransportClosed("receive-only endpoint")
+        if self.mode == "rep":
+            chan = self._reply_to
+            if chan is None:
+                raise TransportClosed("rep endpoint has no request to answer")
+            self._reply_to = None
+            chan.send(payload)
+            return
+        use_credit = self.mode == "w"
+        while True:
+            with self._chan_lock:
+                if self._closed:
+                    raise TransportClosed("endpoint closed")
+                chan = None
+                live = self._channels
+                if live:
+                    # Strict message-level round-robin (the tested fairness
+                    # contract for push queues), gated on peer credit in
+                    # w-mode so frames only go to peers ready to take them.
+                    n = len(live)
+                    for step in range(1, n + 1):
+                        cand = live[(self._rr + step) % n]
+                        if not use_credit or cand.credit > 0:
+                            self._rr = (self._rr + step) % n
+                            chan = cand
+                            if use_credit:
+                                cand.credit -= 1
+                            break
+                if chan is None:
+                    if not self._chan_lock.wait(timeout):
+                        raise TimeoutError(
+                            "no connected peer ready to accept"
+                        ) from None
+            if chan is not None:
+                try:
+                    chan.send(payload)
+                    return
+                except OSError:
+                    self._drop_channel(chan)
+
+    def _maybe_grant(self) -> None:
+        """Demand-driven credit for connected r-endpoints: grant one credit
+        per reader actually waiting, never more (a dead consumer therefore
+        never has frames parked in its socket buffer)."""
+        with self._recv_lock:
+            if (self._inbox.qsize() + self._credit_outstanding
+                    >= self._waiting_readers):
+                return
+            self._credit_outstanding += 1
+        with self._chan_lock:
+            chan = self._channels[0] if self._channels else None
+        if chan is not None:
+            try:
+                chan.send_credit(1)
+            except OSError:
+                pass
+
+    @property
+    def _demand_driven(self) -> bool:
+        return self.mode == "r" and not self._is_bound
+
+    def recv(self, timeout: Optional[float] = None) -> bytes:
+        if self.mode == "w":
+            raise TransportClosed("send-only endpoint")
+        demand = self._demand_driven
+        if demand:
+            with self._recv_lock:
+                self._waiting_readers += 1
+            self._maybe_grant()
+        item = self._inbox.get(timeout=timeout)
+        if item is _SENTINEL_EMPTY:
+            if demand:
+                with self._recv_lock:
+                    self._waiting_readers -= 1
+            raise TimeoutError("recv timed out")
+        if item is _SENTINEL:
+            self._inbox.put(_SENTINEL)  # wake other readers too
+            if demand:
+                with self._recv_lock:
+                    self._waiting_readers -= 1
+            raise TransportClosed("endpoint closed")
+        chan, frame = item
+        if demand:
+            with self._recv_lock:
+                self._credit_outstanding -= 1
+                self._waiting_readers -= 1
+            self._maybe_grant()  # top up for any other blocked readers
+        elif self.mode == "r":
+            # Bound ingress: replenish the standing window.
+            try:
+                chan.send_credit(1)
+            except OSError:
+                pass
+        if self.mode == "rep":
+            self._reply_to = chan
+        return frame
+
+    def poll(self, timeout: Optional[float] = 0.0) -> bool:
+        """True if a data frame is ready (or arrives within timeout).
+        Never consumes or reorders frames."""
+        if not self._inbox.empty():
+            return not self._is_closed_head()
+        if not timeout:
+            return False
+        if self._demand_driven:
+            with self._recv_lock:
+                self._waiting_readers += 1
+            self._maybe_grant()
+        try:
+            item = self._inbox.peek(timeout=timeout)
+            return item is not _SENTINEL_EMPTY and item is not _SENTINEL
+        finally:
+            if self._demand_driven:
+                with self._recv_lock:
+                    self._waiting_readers -= 1
+
+    def _is_closed_head(self) -> bool:
+        head = self._inbox.peek(0)
+        return head is _SENTINEL
+
+    # -- lifecycle --------------------------------------------------------
+    def peer_count(self) -> int:
+        with self._chan_lock:
+            return len(self._channels)
+
+    def wait_for_peers(self, n: int, timeout: Optional[float] = None) -> bool:
+        """Block until at least n peers are connected."""
+        with self._chan_lock:
+            return self._chan_lock.wait_for(
+                lambda: len(self._channels) >= n, timeout
+            )
+
+    def fileno(self) -> int:
+        """Fd of the sole channel (connected endpoints only)."""
+        with self._chan_lock:
+            if len(self._channels) != 1:
+                raise ValueError(
+                    "fileno() requires exactly one connected channel"
+                )
+            return self._channels[0].sock.fileno()
+
+    def close(self) -> None:
+        with self._chan_lock:
+            if self._closed:
+                return
+            self._closed = True
+            channels = list(self._channels)
+            self._channels = []
+            self._chan_lock.notify_all()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        for chan in channels:
+            chan.close()
+        self._inbox.put(_SENTINEL)
+
+    def __del__(self) -> None:  # pragma: no cover - GC path
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def parse_addr(addr: str) -> Tuple[str, int]:
+    if addr.startswith("tcp://"):
+        addr = addr[len("tcp://"):]
+    host, port_s = addr.rsplit(":", 1)
+    return host, int(port_s)
+
+
+class Device:
+    """A forwarder bound to two stable addresses (reference: the nanomsg
+    ``nn_device`` under every queue, fiber/socket.py:297-320).
+
+    ``Device("r", "w")``: producers dial ``in_addr`` with mode ``w``;
+    consumers dial ``out_addr`` with mode ``r``; one pump thread forwards
+    in→out with round-robin fan-out. ``Device("rw", "rw")`` is a duplex
+    relay (Pipe): frames arriving on either side are forwarded to the
+    other.
+    """
+
+    def __init__(self, in_mode: str, out_mode: str, ip: str) -> None:
+        self.in_ep = Endpoint(in_mode)
+        self.out_ep = Endpoint(out_mode)
+        self.in_addr = self.in_ep.bind(ip)
+        self.out_addr = self.out_ep.bind(ip)
+        self._pumps: List[threading.Thread] = []
+        if in_mode == "rw" and out_mode == "rw":
+            self._start_pump(self.in_ep, self.out_ep)
+            self._start_pump(self.out_ep, self.in_ep)
+        else:
+            self._start_pump(self.in_ep, self.out_ep)
+
+    def _start_pump(self, src: Endpoint, dst: Endpoint) -> None:
+        t = threading.Thread(
+            target=self._pump, args=(src, dst),
+            name="fiber-device-pump", daemon=True,
+        )
+        t.start()
+        self._pumps.append(t)
+
+    @staticmethod
+    def _pump(src: Endpoint, dst: Endpoint) -> None:
+        while True:
+            try:
+                frame = src.recv()
+            except (TransportClosed, OSError):
+                return
+            while True:
+                try:
+                    dst.send(frame, timeout=1.0)
+                    break
+                except TimeoutError:
+                    if src._closed or dst._closed:
+                        return
+                except (TransportClosed, OSError):
+                    return
+
+    def close(self) -> None:
+        self.in_ep.close()
+        self.out_ep.close()

@@ -144,7 +144,12 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     if args.master:
-        conn = _connect_active(args.master, args.ident)
+        try:
+            conn = _connect_active(args.master, args.ident)
+        except OSError:
+            # Master vanished between job creation and our dial-in (e.g.
+            # pool shutdown race) — nothing to report to anyone.
+            return 1
     elif args.listen:
         conn = _listen_passive(args.listen, args.ident)
     else:

@@ -68,3 +68,81 @@ def random_error(x):
     if random.random() < 0.05:
         raise ValueError("injected random failure")
     return x
+
+
+def pipe_echo(conn):
+    """Duplex pipe child: echo objects back until None arrives."""
+    while True:
+        obj = conn.recv()
+        if obj is None:
+            break
+        conn.send(("echo", obj))
+
+
+def queue_worker(q_in, q_out):
+    """Read tasks from q_in, square them into q_out, stop on None."""
+    while True:
+        item = q_in.get()
+        if item is None:
+            break
+        q_out.put(item * item)
+
+
+def queue_consume_n(q, n, q_result, tag):
+    """Consume exactly n messages then report (tag, count)."""
+    count = 0
+    for _ in range(n):
+        q.get()
+        count += 1
+    q_result.put((tag, count))
+
+
+def mp_queue_producer(q, items):
+    """Runs inside a *plain multiprocessing* process: fiber queues must
+    work there too (reference: tests/test_queue.py:90-139)."""
+    for item in items:
+        q.put(item)
+
+
+def raise_on_even(x):
+    if x % 2 == 0:
+        raise ValueError(f"even input: {x}")
+    return x
+
+
+_POOL_INIT_VALUE = None
+
+
+def pool_initializer(value):
+    global _POOL_INIT_VALUE
+    _POOL_INIT_VALUE = value
+
+
+def read_initialized(_):
+    return _POOL_INIT_VALUE
+
+
+def die_once_marker(x):
+    """Task 7 hard-kills its worker the first time it runs (marker file
+    prevents the retry from dying again) — exercises resubmission."""
+    import os
+    import tempfile
+
+    if x == 7:
+        marker = os.path.join(tempfile.gettempdir(), "fiber_die_once_marker")
+        if not os.path.exists(marker):
+            with open(marker, "w") as fh:
+                fh.write("died")
+            os._exit(42)
+    return x
+
+
+def pi_inside(n):
+    import random
+
+    count = 0
+    for _ in range(n):
+        x, y = random.random(), random.random()
+        if x * x + y * y <= 1.0:
+            count += 1
+    return count

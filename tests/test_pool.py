@@ -1,0 +1,187 @@
+"""Pool behavior (reference: tests/test_pool.py)."""
+
+import time
+
+import pytest
+
+import fiber_tpu
+from fiber_tpu.pool import RemoteError
+from tests import targets
+
+
+def make_pool(n=2, **kwargs):
+    return fiber_tpu.Pool(n, **kwargs)
+
+
+def test_map_basic():
+    with make_pool(2) as pool:
+        assert pool.map(targets.square, range(10)) == [i * i for i in range(10)]
+
+
+def test_map_ordering_large():
+    with make_pool(3) as pool:
+        xs = list(range(500))
+        assert pool.map(targets.square, xs) == [x * x for x in xs]
+
+
+def test_map_empty():
+    with make_pool(2) as pool:
+        assert pool.map(targets.square, []) == []
+
+
+def test_starmap():
+    with make_pool(2) as pool:
+        assert pool.starmap(targets.add, [(1, 2), (3, 4)]) == [3, 7]
+
+
+def test_apply_and_apply_async():
+    with make_pool(2) as pool:
+        assert pool.apply(targets.add, (2, 3)) == 5
+        res = pool.apply_async(targets.add, (10, 20))
+        assert res.get(30) == 30
+        assert res.successful()
+
+
+def test_imap_ordered():
+    with make_pool(2) as pool:
+        got = list(pool.imap(targets.square, range(40), chunksize=4))
+        assert got == [i * i for i in range(40)]
+
+
+def test_imap_unordered():
+    with make_pool(2) as pool:
+        got = sorted(pool.imap_unordered(targets.square, range(40),
+                                         chunksize=4))
+        assert got == sorted(i * i for i in range(40))
+
+
+def test_map_async_callback():
+    hits = []
+    with make_pool(2) as pool:
+        res = pool.map_async(
+            targets.square, range(5), callback=hits.append
+        )
+        assert res.get(30) == [0, 1, 4, 9, 16]
+        deadline = time.time() + 10
+        while not hits and time.time() < deadline:
+            time.sleep(0.01)
+    assert hits == [[0, 1, 4, 9, 16]]
+
+
+def test_worker_exception_raises_remote_error():
+    with make_pool(2) as pool:
+        with pytest.raises(RemoteError) as excinfo:
+            pool.map(targets.raise_on_even, range(4))
+        assert "even input" in str(excinfo.value)
+
+
+def test_error_handling_under_random_failures():
+    """~5% of tasks raise; failures surface as RemoteError per item via
+    imap_unordered (unlike the reference, a task exception does not kill
+    the worker — it ships the error), and the pool keeps serving the
+    remaining 95% correctly under load."""
+    with make_pool(2) as pool:
+        ok, failed = 0, 0
+        it = pool.imap_unordered(targets.random_error, range(300),
+                                 chunksize=8)
+        while True:
+            try:
+                next(it)
+                ok += 1
+            except RemoteError:
+                failed += 1
+            except StopIteration:
+                break
+        assert ok + failed == 300
+        assert ok > 200  # 5% failure rate can't plausibly kill 100 of 300
+
+
+def test_resilient_resubmission_on_worker_death():
+    """Tasks that kill their worker still complete eventually via
+    resubmission (reference: ResilientZPool pending table)."""
+    import os
+    import tempfile
+
+    marker = os.path.join(tempfile.gettempdir(), "fiber_die_once_marker")
+    if os.path.exists(marker):
+        os.remove(marker)
+    with make_pool(2) as pool:
+        # one poison task that kills its worker once, rest are normal
+        results = pool.map(targets.die_once_marker, range(30), chunksize=1)
+        assert sorted(results) == sorted(range(30))
+
+
+def test_non_resilient_pool():
+    with fiber_tpu.Pool(2, error_handling=False) as pool:
+        assert pool.map(targets.square, range(20)) == [
+            i * i for i in range(20)
+        ]
+
+
+def test_pool_rejects_conflicting_meta():
+    from fiber_tpu.meta import meta
+
+    @meta(cpu=1)
+    def f1(x):
+        return x
+
+    @meta(cpu=4)
+    def f2(x):
+        return x
+
+    with make_pool(2) as pool:
+        pool.map(targets.square, range(4))
+        with pytest.raises(ValueError):
+            pool.map_async(f2, range(4))
+
+
+def test_pool_with_initializer(tmp_path):
+    with fiber_tpu.Pool(
+        2, initializer=targets.pool_initializer, initargs=(41,)
+    ) as pool:
+        results = pool.map(targets.read_initialized, range(4))
+        assert results == [41] * 4
+
+
+def test_pool_submit_after_close_raises():
+    pool = make_pool(2)
+    pool.map(targets.square, [1])
+    pool.close()
+    with pytest.raises(ValueError):
+        pool.map(targets.square, [2])
+    pool.join()
+
+
+def test_create_job_timeout_retry():
+    """First create_job calls fail; the pool still completes its map
+    (reference: TimeoutBackend, tests/test_process.py:27-39,180-190)."""
+    from fiber_tpu.backends import get_backend
+
+    backend = get_backend("local")
+    orig = backend.create_job
+    state = {"fails": 2}
+
+    def flaky(spec):
+        if state["fails"] > 0:
+            state["fails"] -= 1
+            raise TimeoutError("injected create_job timeout")
+        return orig(spec)
+
+    backend.create_job = flaky
+    try:
+        with make_pool(2) as pool:
+            assert pool.map(targets.square, range(10)) == [
+                i * i for i in range(10)
+            ]
+    finally:
+        backend.create_job = orig
+    assert state["fails"] == 0
+
+
+def test_pi_estimation_smoke():
+    """The reference demo workload (examples/pi_estimation.py; reference
+    smoke test tests/test_pool.py:272-280)."""
+    with make_pool(2) as pool:
+        inside = sum(pool.map(targets.pi_inside, [1000] * 4))
+    pi = 4 * inside / 4000
+    assert 2.5 < pi < 3.8

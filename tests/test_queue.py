@@ -1,0 +1,132 @@
+"""Pipes + SimpleQueue across processes (reference: tests/test_queue.py)."""
+
+import multiprocessing
+import queue as pyqueue
+
+import pytest
+
+import fiber_tpu
+from tests import targets
+
+
+def test_pipe_in_process():
+    c1, c2 = fiber_tpu.Pipe()
+    c1.send({"a": 1})
+    assert c2.recv(5) == {"a": 1}
+    c2.send([1, 2, 3])
+    assert c1.recv(5) == [1, 2, 3]
+    c1.close()
+    c2.close()
+
+
+def test_pipe_non_duplex():
+    reader, writer = fiber_tpu.Pipe(duplex=False)
+    writer.send("one-way")
+    assert reader.recv(5) == "one-way"
+    reader.close()
+    writer.close()
+
+
+def test_pipe_with_fiber_process():
+    parent_end, child_end = fiber_tpu.Pipe()
+    p = fiber_tpu.Process(target=targets.pipe_echo, args=(child_end,))
+    p.start()
+    parent_end.send(42)
+    assert parent_end.recv(30) == ("echo", 42)
+    parent_end.send("hi")
+    assert parent_end.recv(30) == ("echo", "hi")
+    parent_end.send(None)
+    p.join(30)
+    assert p.exitcode == 0
+    parent_end.close()
+
+
+def test_simple_queue_in_process():
+    q = fiber_tpu.SimpleQueue()
+    q.put(1)
+    q.put("two")
+    assert q.get(5) == 1
+    assert q.get(5) == "two"
+    assert q.empty()
+    q.close()
+
+
+def test_simple_queue_get_timeout():
+    q = fiber_tpu.SimpleQueue()
+    with pytest.raises(pyqueue.Empty):
+        q.get(0.2)
+    q.close()
+
+
+def test_queue_with_fiber_process():
+    q_in = fiber_tpu.SimpleQueue()
+    q_out = fiber_tpu.SimpleQueue()
+    p = fiber_tpu.Process(target=targets.queue_worker, args=(q_in, q_out))
+    p.start()
+    for i in range(10):
+        q_in.put(i)
+    results = sorted(q_out.get(30) for _ in range(10))
+    assert results == [i * i for i in range(10)]
+    q_in.put(None)
+    p.join(30)
+    assert p.exitcode == 0
+    q_in.close()
+    q_out.close()
+
+
+def test_queue_with_plain_multiprocessing_process():
+    """fiber queues are picklable into plain mp children (reference:
+    tests/test_queue.py:90-139)."""
+    q = fiber_tpu.SimpleQueue()
+    ctx = multiprocessing.get_context("spawn")
+    p = ctx.Process(
+        target=targets.mp_queue_producer, args=(q, list(range(5)))
+    )
+    p.start()
+    got = sorted(q.get(30) for _ in range(5))
+    assert got == list(range(5))
+    p.join(30)
+    assert p.exitcode == 0
+    q.close()
+
+
+def test_queue_passed_through_queue():
+    """A queue can ride inside another queue (connections re-dial after
+    unpickling)."""
+    carrier = fiber_tpu.SimpleQueue()
+    payload_q = fiber_tpu.SimpleQueue()
+    carrier.put(payload_q)
+    recovered = carrier.get(5)
+    recovered.put("via carrier")
+    assert payload_q.get(5) == "via carrier"
+    carrier.close()
+    payload_q.close()
+
+
+def test_round_robin_fairness_across_processes():
+    """4 consumers x 600 messages: each consumer gets exactly 600
+    (reference: tests/test_queue.py:218-250 — the load-balance contract)."""
+    n_workers, per_worker = 4, 600
+    q = fiber_tpu.SimpleQueue()
+    q_result = fiber_tpu.SimpleQueue()
+    procs = [
+        fiber_tpu.Process(
+            target=targets.queue_consume_n,
+            args=(q, per_worker, q_result, i),
+        )
+        for i in range(n_workers)
+    ]
+    for p in procs:
+        p.start()
+    # Exact fairness requires all consumers in the rotation before the
+    # first send.
+    assert q.wait_consumers(n_workers, 60)
+    for i in range(n_workers * per_worker):
+        q.put(i)
+    counts = dict(q_result.get(60) for _ in range(n_workers))
+    for p in procs:
+        p.join(30)
+        assert p.exitcode == 0
+    assert counts == {i: per_worker for i in range(n_workers)}
+    q.close()
+    q_result.close()

@@ -1,0 +1,511 @@
+"""Shared-state managers: a server process owning Python objects, driven by
+method-call proxies from anywhere in the process tree.
+
+Reference parity: fiber/managers.py (SyncManager + AsyncManager). Design
+choices kept from the reference:
+
+* The proxy RPC rides stdlib ``multiprocessing.connection`` (length-prefixed
+  pickle with HMAC auth) — a deliberately separate, battle-tested transport
+  from the queue data plane (reference: fiber/managers.py:26-31).
+* The server runs inside a ``fiber_tpu.Process`` and hands its address back
+  through a fiber Pipe (reference: fiber/managers.py:154-187).
+* ``AsyncManager`` proxies return futures immediately; each in-flight call
+  owns a connection, and the server serves connections in parallel threads,
+  so N slow calls overlap (reference: fiber/managers.py:433-586).
+"""
+
+from __future__ import annotations
+
+import queue as pyqueue
+import threading
+import traceback
+from multiprocessing.connection import Client, Listener
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from fiber_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+_CREATE = "#CREATE"
+_SHUTDOWN = "#SHUTDOWN"
+_PING = "#PING"
+
+
+class Namespace:
+    def __init__(self, **kwargs: Any) -> None:
+        self.__dict__.update(kwargs)
+
+    def __repr__(self) -> str:
+        items = ", ".join(f"{k}={v!r}" for k, v in self.__dict__.items())
+        return f"Namespace({items})"
+
+
+class _Value:
+    def __init__(self, typecode: str, value: Any) -> None:
+        self._typecode = typecode
+        self._value = value
+
+    def get(self) -> Any:
+        return self._value
+
+    def set(self, value: Any) -> None:
+        self._value = value
+
+
+def _make_array(typecode: str, seq) -> list:
+    return list(seq)
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+
+class Server:
+    """Owns the shared objects; serves one thread per client connection so
+    independent proxies progress in parallel."""
+
+    def __init__(self, registry: Dict[str, Callable], authkey: bytes) -> None:
+        from fiber_tpu.backends import get_backend
+
+        self._registry = registry
+        self._listener = Listener(("0.0.0.0", 0), authkey=bytes(authkey))
+        ip, _, _ = get_backend().get_listen_addr()
+        self.address: Tuple[str, int] = (ip, self._listener.address[1])
+        self._objects: Dict[int, Any] = {}
+        self._next_ident = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+
+    def serve_forever(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                break
+            threading.Thread(
+                target=self._serve_connection, args=(conn,),
+                name="fiber-manager-conn", daemon=True,
+            ).start()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def _serve_connection(self, conn) -> None:
+        try:
+            while True:
+                request = conn.recv()
+                ident, method, args, kwargs = request
+                try:
+                    result = self._dispatch(ident, method, args, kwargs)
+                except SystemExit:
+                    conn.send((True, None))
+                    raise
+                except BaseException as exc:  # noqa: BLE001 - shipped back
+                    conn.send((False, (exc, traceback.format_exc())))
+                    continue
+                conn.send((True, result))
+        except (EOFError, OSError):
+            pass
+        except SystemExit:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, ident, method, args, kwargs):
+        if ident == 0:  # control plane
+            if method == _CREATE:
+                typeid = args[0]
+                factory = self._registry[typeid]
+                obj = factory(*args[1:], **kwargs)
+                with self._lock:
+                    self._next_ident += 1
+                    new_ident = self._next_ident
+                    self._objects[new_ident] = obj
+                return new_ident
+            if method == _PING:
+                return "pong"
+            if method == _SHUTDOWN:
+                self._stop.set()
+                try:
+                    self._listener.close()
+                except OSError:
+                    pass
+                raise SystemExit(0)
+            raise ValueError(f"unknown control method {method!r}")
+        obj = self._objects[ident]
+        if method == "#GETVALUE":
+            return obj
+        fn = getattr(obj, method)
+        result = fn(*args, **kwargs)
+        # Views/iterators can't pickle; ship a snapshot list instead.
+        if isinstance(result, (type({}.keys()), type({}.values()),
+                               type({}.items()))):
+            result = list(result)
+        return result
+
+
+def _run_server(registry, writer, authkey) -> None:
+    server = Server(registry, authkey)
+    writer.send(server.address)
+    writer.close()
+    server.serve_forever()
+
+
+# ---------------------------------------------------------------------------
+# Proxies
+# ---------------------------------------------------------------------------
+
+
+class BaseProxy:
+    """Synchronous proxy: one lazily-opened, lock-serialized connection per
+    proxy instance per process; picklable as (address, ident, typeid)."""
+
+    _exposed_: Tuple[str, ...] = ()
+
+    def __init__(self, address, ident: int, typeid: str,
+                 authkey: Optional[bytes] = None) -> None:
+        self._address = tuple(address)
+        self._ident = ident
+        self._typeid = typeid
+        self._authkey = authkey
+        self._conn = None
+        self._conn_lock = threading.Lock()
+
+    def _resolve_authkey(self) -> bytes:
+        if self._authkey is not None:
+            return bytes(self._authkey)
+        from fiber_tpu.process import current_process
+
+        return bytes(current_process().authkey)
+
+    def _call(self, method: str, *args: Any, **kwargs: Any) -> Any:
+        with self._conn_lock:
+            if self._conn is None:
+                self._conn = Client(self._address,
+                                    authkey=self._resolve_authkey())
+            self._conn.send((self._ident, method, args, kwargs))
+            ok, payload = self._conn.recv()
+        if ok:
+            return payload
+        exc, tb = payload
+        raise type(exc)(*exc.args) if _rebuildable(exc) else RuntimeError(
+            f"{exc!r}\n\nRemote traceback:\n{tb}"
+        )
+
+    # pickling: authkey travels implicitly via the fiber process tree
+    def __reduce__(self):
+        return (
+            _rebuild_proxy,
+            (type(self), self._address, self._ident, self._typeid),
+        )
+
+    def __repr__(self) -> str:
+        return (f"<{type(self).__name__} {self._typeid} ident={self._ident} "
+                f"at {self._address}>")
+
+
+def _rebuildable(exc: BaseException) -> bool:
+    try:
+        type(exc)(*exc.args)
+        return True
+    except Exception:
+        return False
+
+
+def _rebuild_proxy(cls, address, ident, typeid):
+    return cls(address, ident, typeid)
+
+
+def MakeProxyType(name: str, exposed: Tuple[str, ...],
+                  base=BaseProxy) -> type:
+    """Generate a proxy class whose listed methods forward remotely
+    (reference: fiber/managers.py:304-345)."""
+
+    namespace: Dict[str, Any] = {"_exposed_": tuple(exposed)}
+    for method in exposed:
+        def make(m):
+            def call(self, *args, **kwargs):
+                return self._call(m, *args, **kwargs)
+
+            call.__name__ = m
+            return call
+
+        namespace[method] = make(method)
+    return type(name, (base,), namespace)
+
+
+_LIST_METHODS = (
+    "append", "extend", "insert", "pop", "remove", "index", "count",
+    "sort", "reverse", "clear", "__getitem__", "__setitem__",
+    "__delitem__", "__len__", "__contains__",
+)
+_DICT_METHODS = (
+    "get", "keys", "values", "items", "update", "pop", "clear",
+    "setdefault", "__getitem__", "__setitem__", "__delitem__", "__len__",
+    "__contains__",
+)
+_QUEUE_METHODS = ("put", "get", "put_nowait", "get_nowait", "qsize",
+                  "empty", "full")
+_JQUEUE_METHODS = _QUEUE_METHODS + ("task_done", "join")
+_EVENT_METHODS = ("set", "clear", "is_set", "wait")
+
+ListProxy = MakeProxyType("ListProxy", _LIST_METHODS)
+DictProxy = MakeProxyType("DictProxy", _DICT_METHODS)
+QueueProxy = MakeProxyType("QueueProxy", _QUEUE_METHODS)
+JoinableQueueProxy = MakeProxyType("JoinableQueueProxy", _JQUEUE_METHODS)
+EventProxy = MakeProxyType("EventProxy", _EVENT_METHODS)
+_ValueProxyBase = MakeProxyType("_ValueProxyBase", ("get", "set"))
+ArrayProxy = MakeProxyType("ArrayProxy", (
+    "__getitem__", "__setitem__", "__len__",
+))
+
+
+class ValueProxy(_ValueProxyBase):
+    @property
+    def value(self):
+        return self._call("get")
+
+    @value.setter
+    def value(self, v):
+        self._call("set", v)
+
+
+class _IterMixin:
+    def __iter__(self):
+        return iter(self._call("#GETVALUE"))
+
+
+class ListProxyIter(ListProxy, _IterMixin):
+    def _getcopy(self):
+        return self._call("#GETVALUE")
+
+
+class DictProxyIter(DictProxy, _IterMixin):
+    def _getcopy(self):
+        return self._call("#GETVALUE")
+
+
+class NamespaceProxy(BaseProxy):
+    _exposed_ = ("__getattribute__", "__setattr__", "__delattr__")
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            return object.__getattribute__(self, name)
+        return self._call("__getattribute__", name)
+
+    def __setattr__(self, name, value):
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+            return
+        self._call("__setattr__", name, value)
+
+    def __delattr__(self, name):
+        if name.startswith("_"):
+            object.__delattr__(self, name)
+            return
+        self._call("__delattr__", name)
+
+
+# ---------------------------------------------------------------------------
+# Async proxies (futures)
+# ---------------------------------------------------------------------------
+
+
+class AsyncProxyResult:
+    """Future for one async proxy call; holds its connection until read
+    (reference: fiber/managers.py:433-458)."""
+
+    def __init__(self, proxy: "AsyncBaseProxy", conn) -> None:
+        self._proxy = proxy
+        self._conn = conn
+        self._done = False
+        self._ok: Optional[bool] = None
+        self._payload: Any = None
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        if not self._done:
+            if timeout is not None and not self._conn.poll(timeout):
+                raise TimeoutError("async manager call timed out")
+            self._ok, self._payload = self._conn.recv()
+            self._done = True
+            self._proxy._release_conn(self._conn)
+            self._conn = None
+        if self._ok:
+            return self._payload
+        exc, tb = self._payload
+        raise type(exc)(*exc.args) if _rebuildable(exc) else RuntimeError(
+            f"{exc!r}\n\nRemote traceback:\n{tb}"
+        )
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if self._done:
+            return True
+        return self._conn.poll(timeout)
+
+
+class AsyncBaseProxy(BaseProxy):
+    """Async proxy: every method returns AsyncProxyResult immediately.
+    Each outstanding call owns a pooled connection, so calls overlap."""
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(*args, **kwargs)
+        self._free_conns: list = []
+        self._pool_lock = threading.Lock()
+
+    def _acquire_conn(self):
+        with self._pool_lock:
+            if self._free_conns:
+                return self._free_conns.pop()
+        return Client(self._address, authkey=self._resolve_authkey())
+
+    def _release_conn(self, conn) -> None:
+        with self._pool_lock:
+            if len(self._free_conns) < 16:
+                self._free_conns.append(conn)
+                return
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _call(self, method: str, *args: Any, **kwargs: Any):
+        conn = self._acquire_conn()
+        conn.send((self._ident, method, args, kwargs))
+        return AsyncProxyResult(self, conn)
+
+    def __setattr__(self, name, value):
+        object.__setattr__(self, name, value)
+
+
+def MakeAsyncProxyType(name: str, exposed: Tuple[str, ...]) -> type:
+    return MakeProxyType(name, exposed, base=AsyncBaseProxy)
+
+
+# ---------------------------------------------------------------------------
+# Managers
+# ---------------------------------------------------------------------------
+
+
+class BaseManager:
+    """Starts/stops the server process; factory methods create shared
+    objects and wrap them in proxies."""
+
+    _registry: Dict[str, Tuple[Callable, type]] = {}
+
+    def __init__(self) -> None:
+        self._process = None
+        self._address: Optional[Tuple[str, int]] = None
+        self._authkey: Optional[bytes] = None
+        self._control: Optional[BaseProxy] = None
+
+    # -- registration -------------------------------------------------
+    @classmethod
+    def register(cls, typeid: str, factory: Callable, proxytype: type) -> None:
+        # subclasses get their own registry dict
+        if "_registry" not in cls.__dict__:
+            cls._registry = dict(cls._registry)
+        cls._registry[typeid] = (factory, proxytype)
+
+        def make(self, *args: Any, **kwargs: Any):
+            return self._create(typeid, *args, **kwargs)
+
+        make.__name__ = typeid
+        setattr(cls, typeid, make)
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "BaseManager":
+        from fiber_tpu.process import Process, current_process
+        from fiber_tpu.queues import Pipe
+
+        if self._process is not None:
+            raise AssertionError("manager already started")
+        self._authkey = bytes(current_process().authkey)
+        reader, writer = Pipe(duplex=False)
+        factories = {tid: fac for tid, (fac, _) in self._registry.items()}
+        self._process = Process(
+            target=_run_server,
+            args=(factories, writer, self._authkey),
+            name=f"Manager-{id(self):x}",
+            daemon=True,
+        )
+        self._process.start()
+        self._address = tuple(reader.recv(60))
+        reader.close()
+        self._control = BaseProxy(self._address, 0, "#control",
+                                  authkey=self._authkey)
+        return self
+
+    @property
+    def address(self):
+        return self._address
+
+    def _create(self, typeid: str, *args: Any, **kwargs: Any):
+        if self._control is None:
+            raise AssertionError("manager not started")
+        ident = self._control._call(_CREATE, typeid, *args, **kwargs)
+        proxytype = self._registry[typeid][1]
+        return proxytype(self._address, ident, typeid, authkey=self._authkey)
+
+    def shutdown(self) -> None:
+        if self._control is not None:
+            try:
+                self._control._call(_SHUTDOWN)
+            except Exception:
+                pass
+            self._control = None
+        if self._process is not None:
+            self._process.join(15)
+            if self._process.is_alive():
+                self._process.terminate()
+                self._process.join(10)
+            self._process = None
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._process is not None:
+            self._process.join(timeout)
+
+    def __enter__(self) -> "BaseManager":
+        if self._process is None:
+            self.start()
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.shutdown()
+
+
+class SyncManager(BaseManager):
+    pass
+
+
+SyncManager.register("Queue", pyqueue.Queue, QueueProxy)
+SyncManager.register("JoinableQueue", pyqueue.Queue, JoinableQueueProxy)
+SyncManager.register("Event", threading.Event, EventProxy)
+SyncManager.register("list", list, ListProxyIter)
+SyncManager.register("dict", dict, DictProxyIter)
+SyncManager.register("Namespace", Namespace, NamespaceProxy)
+SyncManager.register("Value", _Value, ValueProxy)
+SyncManager.register("Array", _make_array, ArrayProxy)
+
+
+class AsyncManager(BaseManager):
+    """Same registry, but every proxy method returns a future
+    (reference: fiber/managers.py AsyncManager)."""
+
+
+def _register_async(typeid: str, factory: Callable,
+                    sync_proxy: type) -> None:
+    exposed = getattr(sync_proxy, "_exposed_", ())
+    async_proxy = MakeAsyncProxyType(f"Async{sync_proxy.__name__}", exposed)
+    AsyncManager.register(typeid, factory, async_proxy)
+
+
+for _tid, (_fac, _proxy) in list(SyncManager._registry.items()):
+    _register_async(_tid, _fac, _proxy)
+
+# A generic callable wrapper so AsyncManager can host arbitrary user
+# objects: manager.register_instance-style usage via `Object`.

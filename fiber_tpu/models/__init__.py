@@ -1,0 +1,5 @@
+"""Model zoo for the framework's population-based workloads: policy
+networks and pure-JAX environments whose rollouts compile end-to-end."""
+
+from fiber_tpu.models.policies import MLPPolicy, ConvPolicy  # noqa: F401
+from fiber_tpu.models.envs import CartPole, Pendulum  # noqa: F401

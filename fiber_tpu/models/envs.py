@@ -1,0 +1,168 @@
+"""Pure-JAX environments: physics as jittable step functions, rollouts as
+``lax.scan`` — the whole episode compiles into one XLA program with static
+shapes (no Python in the loop), which is what lets a TPU evaluate whole
+populations of policies in data-parallel lockstep.
+
+CartPole matches the classic Gym CartPole-v1 dynamics (the north-star
+OpenAI-ES workload, BASELINE.json configs); Pendulum is the continuous
+control smoke env.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+class CartPole:
+    obs_dim = 4
+    act_dim = 2
+    max_steps = 500
+
+    # physics constants (Gym CartPole-v1)
+    gravity = 9.8
+    masscart = 1.0
+    masspole = 0.1
+    length = 0.5          # half pole length
+    force_mag = 10.0
+    tau = 0.02
+    theta_threshold = 12 * 3.141592653589793 / 180.0
+    x_threshold = 2.4
+
+    @classmethod
+    def reset(cls, key):
+        import jax
+
+        return jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
+
+    @classmethod
+    def step(cls, state, action):
+        """One physics step. action in {0, 1}. Returns (state, terminated)."""
+        import jax.numpy as jnp
+
+        x, x_dot, theta, theta_dot = state
+        force = jnp.where(action == 1, cls.force_mag, -cls.force_mag)
+        costheta = jnp.cos(theta)
+        sintheta = jnp.sin(theta)
+        total_mass = cls.masscart + cls.masspole
+        polemass_length = cls.masspole * cls.length
+
+        temp = (force + polemass_length * theta_dot**2 * sintheta) / total_mass
+        thetaacc = (cls.gravity * sintheta - costheta * temp) / (
+            cls.length * (4.0 / 3.0 - cls.masspole * costheta**2 / total_mass)
+        )
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+
+        x = x + cls.tau * x_dot
+        x_dot = x_dot + cls.tau * xacc
+        theta = theta + cls.tau * theta_dot
+        theta_dot = theta_dot + cls.tau * thetaacc
+        new_state = jnp.stack([x, x_dot, theta, theta_dot])
+        terminated = (
+            (jnp.abs(x) > cls.x_threshold)
+            | (jnp.abs(theta) > cls.theta_threshold)
+        )
+        return new_state, terminated
+
+    @classmethod
+    def rollout(cls, act_fn: Callable, flat_params, key,
+                max_steps: int | None = None):
+        """Total episode reward for a deterministic policy; fully jittable.
+
+        ``act_fn(flat_params, obs) -> action``. Termination is handled by
+        masking inside the scan (static shapes, no early exit).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        steps = max_steps or cls.max_steps
+        state0 = cls.reset(key)
+
+        def scan_step(carry, _):
+            state, done, total = carry
+            action = act_fn(flat_params, state)
+            next_state, terminated = cls.step(state, action)
+            reward = jnp.where(done, 0.0, 1.0)
+            new_done = done | terminated
+            new_state = jnp.where(done, state, next_state)
+            return (new_state, new_done, total + reward), None
+
+        (final_state, done, total), _ = jax.lax.scan(
+            scan_step, (state0, jnp.asarray(False), jnp.asarray(0.0)),
+            None, length=steps,
+        )
+        return total
+
+
+class Pendulum:
+    obs_dim = 3
+    act_dim = 1
+    max_steps = 200
+
+    max_speed = 8.0
+    max_torque = 2.0
+    dt = 0.05
+    g = 10.0
+    m = 1.0
+    length = 1.0
+
+    @classmethod
+    def reset(cls, key):
+        import jax
+        import jax.numpy as jnp
+
+        hi = jnp.asarray([3.141592653589793, 1.0])
+        thetadot = jax.random.uniform(key, (2,), minval=-hi, maxval=hi)
+        return thetadot  # (theta, theta_dot)
+
+    @classmethod
+    def obs(cls, state):
+        import jax.numpy as jnp
+
+        theta, theta_dot = state
+        return jnp.stack([jnp.cos(theta), jnp.sin(theta), theta_dot])
+
+    @classmethod
+    def step(cls, state, torque):
+        import jax.numpy as jnp
+
+        theta, theta_dot = state
+        u = jnp.clip(torque, -cls.max_torque, cls.max_torque)
+        cost = (
+            _angle_normalize(theta) ** 2
+            + 0.1 * theta_dot**2
+            + 0.001 * u**2
+        )
+        new_theta_dot = theta_dot + (
+            3 * cls.g / (2 * cls.length) * jnp.sin(theta)
+            + 3.0 / (cls.m * cls.length**2) * u
+        ) * cls.dt
+        new_theta_dot = jnp.clip(new_theta_dot, -cls.max_speed, cls.max_speed)
+        new_theta = theta + new_theta_dot * cls.dt
+        return jnp.stack([new_theta, new_theta_dot]), -cost
+
+    @classmethod
+    def rollout(cls, act_fn: Callable, flat_params, key,
+                max_steps: int | None = None):
+        import jax
+        import jax.numpy as jnp
+
+        steps = max_steps or cls.max_steps
+        state0 = cls.reset(key)
+
+        def scan_step(carry, _):
+            state, total = carry
+            torque = act_fn(flat_params, cls.obs(state))
+            torque = jnp.reshape(torque, ())
+            new_state, reward = cls.step(state, torque)
+            return (new_state, total + reward), None
+
+        (_, total), _ = jax.lax.scan(
+            scan_step, (state0, jnp.asarray(0.0)), None, length=steps
+        )
+        return total
+
+
+def _angle_normalize(x):
+    import jax.numpy as jnp
+
+    return ((x + jnp.pi) % (2 * jnp.pi)) - jnp.pi

@@ -1,0 +1,142 @@
+"""Policy networks for ES/POET workloads, exposed in the flat-vector form
+evolution strategies need (perturbations are dense vectors living on the
+MXU-friendly path: one (pop, dim) matmul-shaped tensor, not a pytree zoo).
+
+Reference parity: the reference's ES examples use small torch MLPs
+(examples/gecco-2020); here policies are pure JAX with a
+``ravel``/``unravel`` pair so a whole population of parameter vectors is a
+single 2-D array.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple
+
+
+class MLPPolicy:
+    """Tanh MLP: obs -> hidden* -> logits, as flat parameter vectors."""
+
+    def __init__(self, obs_dim: int, act_dim: int,
+                 hidden: Sequence[int] = (32, 32)) -> None:
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.sizes = (obs_dim, *hidden, act_dim)
+        self.dim = sum(
+            self.sizes[i] * self.sizes[i + 1] + self.sizes[i + 1]
+            for i in range(len(self.sizes) - 1)
+        )
+
+    def init(self, key):
+        """Flat parameter vector (dim,)."""
+        import jax
+        import jax.numpy as jnp
+
+        parts = []
+        for i in range(len(self.sizes) - 1):
+            key, wk = jax.random.split(key)
+            fan_in = self.sizes[i]
+            w = jax.random.normal(
+                wk, (self.sizes[i], self.sizes[i + 1])
+            ) / jnp.sqrt(fan_in)
+            b = jnp.zeros((self.sizes[i + 1],))
+            parts.append(w.ravel())
+            parts.append(b)
+        return jnp.concatenate(parts)
+
+    def apply(self, flat_params, obs):
+        """Logits for one observation; jittable / vmappable."""
+        import jax.numpy as jnp
+
+        x = obs
+        offset = 0
+        n_layers = len(self.sizes) - 1
+        for i in range(n_layers):
+            n_in, n_out = self.sizes[i], self.sizes[i + 1]
+            w = flat_params[offset:offset + n_in * n_out].reshape(n_in, n_out)
+            offset += n_in * n_out
+            b = flat_params[offset:offset + n_out]
+            offset += n_out
+            x = x @ w + b
+            if i < n_layers - 1:
+                x = jnp.tanh(x)
+        return x
+
+    def act(self, flat_params, obs):
+        """Deterministic discrete action."""
+        import jax.numpy as jnp
+
+        return jnp.argmax(self.apply(flat_params, obs))
+
+
+class ConvPolicy:
+    """Small conv policy for image observations (Atari-style ES), kept in
+    NHWC with bf16-friendly channel sizes so convs tile onto the MXU."""
+
+    def __init__(self, obs_shape: Tuple[int, int, int], act_dim: int,
+                 channels: Sequence[int] = (16, 32),
+                 hidden: int = 128) -> None:
+        self.obs_shape = obs_shape  # (H, W, C)
+        self.act_dim = act_dim
+        self.channels = tuple(channels)
+        self.hidden = hidden
+        h, w, c = obs_shape
+        self._specs = []
+        in_c = c
+        for out_c in self.channels:
+            self._specs.append(("conv", (3, 3, in_c, out_c)))
+            in_c = out_c
+            h, w = (h + 1) // 2, (w + 1) // 2  # stride-2 convs
+        self._flat_len = h * w * in_c
+        self._specs.append(("dense", (self._flat_len, hidden)))
+        self._specs.append(("dense", (hidden, act_dim)))
+        self.dim = sum(
+            int(__import__("numpy").prod(shape)) + shape[-1]
+            for _, shape in self._specs
+        )
+
+    def init(self, key):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        parts = []
+        for kind, shape in self._specs:
+            key, wk = jax.random.split(key)
+            fan_in = int(np.prod(shape[:-1]))
+            w = jax.random.normal(wk, shape) / jnp.sqrt(fan_in)
+            parts.append(w.ravel())
+            parts.append(jnp.zeros((shape[-1],)))
+        return jnp.concatenate(parts)
+
+    def apply(self, flat_params, obs):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        x = obs[None]  # NHWC with N=1
+        offset = 0
+        n = len(self._specs)
+        for i, (kind, shape) in enumerate(self._specs):
+            count = int(np.prod(shape))
+            w = flat_params[offset:offset + count].reshape(shape)
+            offset += count
+            b = flat_params[offset:offset + shape[-1]]
+            offset += shape[-1]
+            if kind == "conv":
+                x = jax.lax.conv_general_dilated(
+                    x, w, window_strides=(2, 2), padding="SAME",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                )
+                x = jnp.tanh(x + b)
+            else:
+                if x.ndim > 2:
+                    x = x.reshape(x.shape[0], -1)
+                x = x @ w + b
+                if i < n - 1:
+                    x = jnp.tanh(x)
+        return x[0]
+
+    def act(self, flat_params, obs):
+        import jax.numpy as jnp
+
+        return jnp.argmax(self.apply(flat_params, obs))

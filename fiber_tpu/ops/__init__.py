@@ -1,0 +1,10 @@
+"""Device-plane ops: XLA collectives wrappers, host ring collectives, and
+the evolution-strategies engine (the framework's flagship workload)."""
+
+from fiber_tpu.ops.collectives import (  # noqa: F401
+    psum_sharded,
+    pmean_sharded,
+    all_gather_sharded,
+    HostRing,
+)
+from fiber_tpu.ops.es import EvolutionStrategy, centered_rank  # noqa: F401

@@ -1,0 +1,200 @@
+"""Collectives: XLA (`lax.psum` over the mesh, riding ICI) on-device, and a
+host-plane ring allreduce over the fiber transport for cross-process numpy
+state.
+
+Reference parity: the reference delegates allreduce to torch.distributed /
+Horovod / gloo bootstrapped by its Ring (fiber/experimental/ring.py,
+examples/ring.py:84-89 `dist.all_reduce`). fiber_tpu is self-contained:
+``HostRing`` implements the classic two-phase ring (reduce-scatter +
+all-gather) directly on framed TCP, and on-device reductions lower to
+``lax.psum`` so gradient traffic rides ICI, not host sockets.
+"""
+
+from __future__ import annotations
+
+import socket as pysocket
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+from fiber_tpu.framing import recv_frame, send_frame
+from fiber_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+# ---------------------------------------------------------------------------
+# On-device collectives (ICI / XLA)
+# ---------------------------------------------------------------------------
+
+
+def psum_sharded(x, mesh=None, axis: str = "pool"):
+    """Sum an array sharded over ``axis`` across all devices; returns the
+    replicated total. Lowers to one XLA all-reduce over ICI."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from fiber_tpu.parallel.mesh import default_mesh
+
+    mesh = mesh or default_mesh()
+
+    def local(shard):
+        return jax.lax.psum(shard.sum(axis=0), axis)
+
+    fn = shard_map(local, mesh=mesh, in_specs=(P(axis),), out_specs=P(),
+                   check_vma=False)
+    return jax.jit(fn)(x)
+
+
+def pmean_sharded(x, mesh=None, axis: str = "pool"):
+    import jax.numpy as jnp
+
+    total = psum_sharded(x, mesh, axis)
+    return total / jnp.asarray(x.shape[0], total.dtype)
+
+
+def all_gather_sharded(x, mesh=None, axis: str = "pool"):
+    """Gather a sharded array to a fully-replicated copy on every device."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from fiber_tpu.parallel.mesh import default_mesh
+
+    mesh = mesh or default_mesh()
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(lambda a: a, out_shardings=replicated)(x)
+
+
+# ---------------------------------------------------------------------------
+# Host-plane ring collectives (DCN / TCP)
+# ---------------------------------------------------------------------------
+
+
+class HostRing:
+    """A ring of processes doing chunked allreduce over framed TCP.
+
+    Build one per rank after rendezvous (every rank knows every
+    ``(ip, port)``). Wire-up: every rank listens at its own address,
+    dials its successor, and accepts its predecessor.
+    """
+
+    def __init__(self, rank: int, size: int,
+                 addrs: Sequence[Tuple[str, int]]) -> None:
+        if size < 2:
+            raise ValueError("HostRing needs size >= 2")
+        self.rank = rank
+        self.size = size
+        ip, port = addrs[rank]
+        listener = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_STREAM)
+        listener.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_REUSEADDR, 1)
+        listener.bind(("", port))
+        listener.listen(2)
+
+        next_ip, next_port = addrs[(rank + 1) % size]
+        self._next: Optional[pysocket.socket] = None
+        self._prev: Optional[pysocket.socket] = None
+
+        def dial():
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                try:
+                    s = pysocket.create_connection((next_ip, next_port), 2.0)
+                    s.setsockopt(pysocket.IPPROTO_TCP,
+                                 pysocket.TCP_NODELAY, 1)
+                    s.settimeout(None)
+                    self._next = s
+                    return
+                except OSError:
+                    time.sleep(0.1)
+
+        t = threading.Thread(target=dial, daemon=True)
+        t.start()
+        listener.settimeout(60)
+        conn, _ = listener.accept()
+        conn.setsockopt(pysocket.IPPROTO_TCP, pysocket.TCP_NODELAY, 1)
+        self._prev = conn
+        t.join(60)
+        listener.close()
+        if self._next is None:
+            raise OSError(f"rank {rank}: could not dial successor")
+
+    # ------------------------------------------------------------------
+    def _exchange(self, payload: bytes) -> bytes:
+        """Send to successor while receiving from predecessor."""
+        err: List[BaseException] = []
+
+        def sender():
+            try:
+                send_frame(self._next, payload)
+            except BaseException as e:  # noqa: BLE001
+                err.append(e)
+
+        t = threading.Thread(target=sender, daemon=True)
+        t.start()
+        data = recv_frame(self._prev)
+        t.join(120)
+        if err:
+            raise err[0]
+        return data
+
+    def allreduce(self, array, op: str = "sum"):
+        """Two-phase ring allreduce; returns the reduced array (all ranks
+        end with identical contents). ~2·(size-1)/size · bytes on the wire
+        per rank — bandwidth-optimal."""
+        import numpy as np
+
+        arr = np.array(array, copy=True)
+        if op not in ("sum", "mean"):
+            raise ValueError(f"unsupported op {op!r}")
+        shape, dtype = arr.shape, arr.dtype
+        flat = arr.ravel()
+        chunks = np.array_split(flat, self.size)
+        rank, size = self.rank, self.size
+
+        # Phase 1 — reduce-scatter: after size-1 steps, rank r owns the
+        # fully-reduced chunk (r+1) % size.
+        for step in range(size - 1):
+            send_idx = (rank - step) % size
+            recv_idx = (rank - step - 1) % size
+            data = self._exchange(chunks[send_idx].tobytes())
+            chunks[recv_idx] = chunks[recv_idx] + np.frombuffer(
+                data, dtype=dtype
+            )
+
+        # Phase 2 — all-gather the reduced chunks around the ring.
+        for step in range(size - 1):
+            send_idx = (rank + 1 - step) % size
+            recv_idx = (rank - step) % size
+            data = self._exchange(chunks[send_idx].tobytes())
+            chunks[recv_idx] = np.frombuffer(data, dtype=dtype)
+
+        out = np.concatenate(chunks).reshape(shape)
+        if op == "mean":
+            out = out / size
+        return out
+
+    def broadcast(self, array, root: int = 0):
+        """Ring broadcast from root (size-1 hops)."""
+        import numpy as np
+
+        if self.rank == root:
+            arr = np.ascontiguousarray(array)
+            send_frame(self._next, arr.tobytes())
+            # sink our own frame when it comes back around
+            recv_frame(self._prev)
+            return arr
+        data = recv_frame(self._prev)
+        arr = np.frombuffer(data, dtype=array.dtype).reshape(array.shape)
+        send_frame(self._next, data)
+        return arr.copy()
+
+    def barrier(self) -> None:
+        self.allreduce(__import__("numpy").zeros(1, dtype="float32"))
+
+    def close(self) -> None:
+        for s in (self._next, self._prev):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass

@@ -1,0 +1,16 @@
+"""The device plane: mesh management, on-device pool lowering, Ring.
+
+This is where fiber_tpu stops porting and starts being TPU-native: the
+host plane (Process/Pool/Queue) schedules arbitrary Python; this package
+lowers *jittable* work onto a ``jax.sharding.Mesh``:
+
+* ``device_map`` — ``Pool.map`` for pure functions: scatter over the mesh,
+  one XLA-compiled vmapped worker per device via ``shard_map``, gather.
+* ``Ring`` — the reference's SPMD topology builder
+  (fiber/experimental/ring.py), whose allreduce lowers to ``lax.psum``
+  on-device and to a host ring over the fiber transport off-device.
+"""
+
+from fiber_tpu.parallel.mesh import default_mesh, mesh_from_config  # noqa: F401
+from fiber_tpu.parallel.dmap import device_map  # noqa: F401
+from fiber_tpu.parallel.ring import Ring, RingNode  # noqa: F401

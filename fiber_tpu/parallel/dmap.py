@@ -1,0 +1,116 @@
+"""``device_map`` — the on-device lowering of ``Pool.map``.
+
+Where the host pool ships pickled chunks to worker processes, device_map
+compiles the task function once and runs the whole map as a single SPMD
+program: inputs are stacked, padded to the mesh size, sharded over the
+``pool`` axis, and each device runs a vmapped copy of the function over its
+shard inside ``shard_map`` (so XLA sees static per-device shapes and can
+tile the math onto the MXU). This is the path that turns
+``Pool.map(policy_eval, population)`` into ≥10k evals/sec instead of
+pickle traffic (BASELINE.json north star).
+
+Functions must be pure and jittable, with pytree-of-array inputs/outputs
+of uniform shape. Mark them ``@fiber_tpu.meta(device=True)`` to make
+``Pool.map`` route here automatically.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Iterable, List, Optional
+
+_compile_cache: dict = {}
+_cache_lock = threading.Lock()
+
+
+def _stack_items(items: List[Any]):
+    """Stack a list of pytrees into one pytree of batched arrays."""
+    import jax
+    import numpy as np
+
+    return jax.tree.map(lambda *leaves: np.stack(leaves), *items)
+
+
+def _compiled_mapper(fn: Callable, mesh, multi_arg: bool):
+    """jit(shard_map(vmap(fn))) over the pool axis, cached per (fn, mesh)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    key = (id(fn), id(mesh), multi_arg)
+    with _cache_lock:
+        cached = _compile_cache.get(key)
+        if cached is not None:
+            return cached
+
+    if multi_arg:
+        def per_item(packed):
+            return fn(*packed)
+    else:
+        per_item = fn
+
+    local = jax.vmap(per_item)
+    spec = P("pool")
+    mapped = shard_map(
+        local, mesh=mesh, in_specs=(spec,), out_specs=spec,
+        check_vma=False,
+    )
+
+    def run(batched):
+        return mapped(batched)
+
+    compiled = jax.jit(run)
+    with _cache_lock:
+        _compile_cache[key] = compiled
+    return compiled
+
+
+def device_map(
+    fn: Callable,
+    iterable: Iterable[Any],
+    mesh=None,
+    star: bool = False,
+) -> List[Any]:
+    """Map a pure jittable function over items on the device mesh.
+
+    Items may be scalars, arrays, or pytrees of arrays (all with identical
+    structure/shapes). With ``star=True`` each item is a tuple of
+    positional args. Returns a list of host (numpy) results in order.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from fiber_tpu.parallel.mesh import default_mesh
+
+    items = list(iterable)
+    if not items:
+        return []
+    mesh = mesh or default_mesh()
+    n = len(items)
+    n_dev = int(np.prod(list(mesh.shape.values())))
+
+    batched = _stack_items(items)
+    pad = (-n) % n_dev
+    if pad:
+        batched = jax.tree.map(
+            lambda a: np.concatenate([a, np.repeat(a[-1:], pad, axis=0)]),
+            batched,
+        )
+
+    sharding = NamedSharding(mesh, P("pool"))
+    device_in = jax.tree.map(
+        lambda a: jax.device_put(np.asarray(a), sharding), batched
+    )
+    compiled = _compiled_mapper(fn, mesh, multi_arg=star)
+    out = compiled(device_in)
+    host = jax.device_get(out)
+    leaves_are_tree = not isinstance(host, (np.ndarray, np.generic))
+    if leaves_are_tree:
+        return [jax.tree.map(lambda a: a[i], host) for i in range(n)]
+    return [host[i] for i in range(n)]
+
+
+def clear_device_map_cache() -> None:
+    with _cache_lock:
+        _compile_cache.clear()

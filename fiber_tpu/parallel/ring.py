@@ -1,0 +1,141 @@
+"""``Ring`` — SPMD process topology builder.
+
+Reference parity: fiber/experimental/ring.py (RingNode/Ring: N processes
+running the same function with (rank, size), rendezvous through a Manager
+list; the reference then delegates collective setup to torch.distributed /
+Horovod via the user initializer — examples/ring.py:141-174).
+
+fiber_tpu is self-contained and TPU-first:
+
+* ``default_initializer`` wires a ``HostRing`` (fiber_tpu.ops.HostRing)
+  over the rendezvous addresses, so ``current_ring().allreduce(grads)``
+  works with zero external frameworks — the gloo-equivalent path.
+* ``jax_distributed_initializer`` instead calls
+  ``jax.distributed.initialize(coordinator, size, rank)`` so each rank
+  becomes a JAX process in one multi-host runtime and reductions lower to
+  ``lax.psum`` over ICI — the TPU pod path.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, List, Optional, Tuple
+
+
+class RingNode:
+    def __init__(self, rank: int, ip: str = "", port: int = 0) -> None:
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self) -> str:
+        return f"RingNode(rank={self.rank}, ip={self.ip!r}, port={self.port})"
+
+
+_current_ring = None
+
+
+def current_ring():
+    """The HostRing built by default_initializer in this rank's process."""
+    if _current_ring is None:
+        raise RuntimeError("no HostRing in this process "
+                           "(did the Ring use default_initializer?)")
+    return _current_ring
+
+
+def default_initializer(rank: int, size: int,
+                        addrs: List[Tuple[str, int]]) -> None:
+    """Build the host-plane ring collective group for this rank."""
+    global _current_ring
+    from fiber_tpu.ops.collectives import HostRing
+
+    _current_ring = HostRing(rank, size, addrs)
+
+
+def jax_distributed_initializer(rank: int, size: int,
+                                addrs: List[Tuple[str, int]]) -> None:
+    """Join all ranks into one JAX distributed runtime (TPU pod path):
+    rank 0's address is the coordinator; afterwards jax.devices() spans
+    every host and collectives ride ICI/DCN."""
+    import jax
+
+    coordinator = f"{addrs[0][0]}:{addrs[0][1]}"
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=size,
+        process_id=rank,
+    )
+
+
+def _ring_target(rank: int, size: int, nodes_proxy, func: Callable,
+                 initializer: Optional[Callable]) -> None:
+    from fiber_tpu.backends import get_backend
+
+    ip, _, _ = get_backend().get_listen_addr()
+    port = random.randint(30000, 50000)  # reference port policy (ring.py:91-98)
+    nodes_proxy[rank] = RingNode(rank, ip, port)
+
+    deadline = time.monotonic() + 120
+    while True:
+        nodes = list(nodes_proxy)
+        if all(n is not None for n in nodes):
+            break
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"rank {rank}: ring rendezvous timed out")
+        time.sleep(0.05)
+    nodes.sort(key=lambda n: n.rank)
+    addrs = [(n.ip, n.port) for n in nodes]
+
+    if initializer is not None:
+        initializer(rank, size, addrs)
+    func(rank, size)
+
+
+class Ring:
+    """Launch ``size`` processes all running ``func(rank, size)`` after
+    ``initializer(rank, size, addrs)`` has wired the collective group."""
+
+    def __init__(self, size: int, func: Callable,
+                 initializer: Optional[Callable] = default_initializer,
+                 ) -> None:
+        if size < 1:
+            raise ValueError("ring size must be >= 1")
+        self.size = size
+        self.func = func
+        self.initializer = initializer
+        self.procs: list = []
+        self._manager = None
+
+    def run(self, join: bool = True) -> None:
+        import fiber_tpu
+        from fiber_tpu.process import Process
+
+        self._manager = fiber_tpu.Manager()
+        nodes = self._manager.list([None] * self.size)
+        self.procs = [
+            Process(
+                target=_ring_target,
+                args=(rank, self.size, nodes, self.func, self.initializer),
+                name=f"RingRank-{rank}",
+            )
+            for rank in range(self.size)
+        ]
+        for p in self.procs:
+            p.start()
+        if join:
+            self.join()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        try:
+            for p in self.procs:
+                p.join(timeout)
+                if p.exitcode not in (0, None):
+                    raise RuntimeError(
+                        f"ring rank process {p.name} exited with "
+                        f"{p.exitcode}"
+                    )
+        finally:
+            if self._manager is not None:
+                self._manager.shutdown()
+                self._manager = None

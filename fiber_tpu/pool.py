@@ -425,10 +425,9 @@ class Pool:
         self._initializer = initializer
         self._initargs = initargs
         self._maxtasksperchild = maxtasksperchild
+        # Workers are packed cpu_per_job sub-workers per job, the last job
+        # taking the remainder (reference: fiber/pool.py:1009-1057).
         self._cpu_per_job = max(1, int(cfg.cpu_per_job))
-        # Number of fiber processes (jobs): workers are packed
-        # cpu_per_job-per-job (reference: fiber/pool.py:1009-1057).
-        self._n_jobs = (processes + self._cpu_per_job - 1) // self._cpu_per_job
 
         ip, _, _ = get_backend().get_listen_addr()
         self._task_ep = Endpoint("rep" if self._resilient else "w")
@@ -437,9 +436,9 @@ class Pool:
         self._result_addr = self._result_ep.bind(ip)
 
         self._store = ResultStore()
+        # Items are (payload, (seq, base)) — the key rides alongside so the
+        # resilient handout never has to re-deserialize the payload.
         self._taskq: "pyqueue.Queue" = pyqueue.Queue()
-        self._inflight = 0
-        self._inflight_lock = threading.Lock()
 
         self._workers: List = []
         self._workers_lock = threading.Lock()
@@ -470,6 +469,9 @@ class Pool:
                 "all functions used with one Pool must share resource meta "
                 f"(pool started with {self._pool_meta}, got {hints})"
             )
+        self._start_worker_thread()
+
+    def _start_worker_thread(self) -> None:
         if self._workers_started:
             return
         self._workers_started = True
@@ -478,10 +480,9 @@ class Pool:
         )
         self._worker_thread.start()
 
-    def _spawn_worker(self):
+    def _spawn_worker(self, n_local: int):
         from fiber_tpu.process import Process
 
-        n_local = min(self._cpu_per_job, self._n_workers)
         p = Process(
             target=pool_worker,
             args=(
@@ -498,6 +499,7 @@ class Pool:
         )
         try:
             p.start()
+            p._n_local = n_local
             return p
         except Exception:
             logger.warning("pool worker start failed; will retry",
@@ -514,20 +516,31 @@ class Pool:
             self._maintain_workers()
             time.sleep(0.2)
 
+    def _draining_done(self) -> bool:
+        return self._closed and self._store.outstanding() == 0
+
     def _maintain_workers(self) -> None:
         with self._workers_lock:
             dead = [p for p in self._workers if p is not None and not p.is_alive()]
             for p in dead:
                 self._workers.remove(p)
                 self._on_worker_death(p)
-            missing = self._n_jobs - len(self._workers)
-        for _ in range(missing):
-            if self._terminated or self._closed:
+            # Sub-worker slots still covered by live jobs; jobs pack
+            # cpu_per_job sub-workers each, the last one the remainder.
+            covered = sum(getattr(p, "_n_local", 1) for p in self._workers)
+        missing_subs = self._n_workers - covered
+        while missing_subs > 0:
+            # Respawning continues through a close() drain (resubmitted
+            # chunks need somewhere to run) and stops only once drained.
+            if self._terminated or self._draining_done():
                 return
-            p = self._spawn_worker()
-            if p is not None:
-                with self._workers_lock:
-                    self._workers.append(p)
+            n_local = min(self._cpu_per_job, missing_subs)
+            p = self._spawn_worker(n_local)
+            if p is None:
+                break  # transient backend failure: retry on the next tick
+            with self._workers_lock:
+                self._workers.append(p)
+            missing_subs -= n_local
 
     def _on_worker_death(self, proc) -> None:
         logger.debug("pool worker %s died", proc.name)
@@ -540,7 +553,7 @@ class Pool:
             item = self._taskq.get()
             if item is None:
                 return
-            payload, nitems = item
+            payload, _key = item
             while self._store.outstanding() > MAX_INFLIGHT_TASKS:
                 if self._terminated:
                     return
@@ -616,7 +629,7 @@ class Pool:
             payload = serialization.dumps(
                 ("task", seq, base, digest, blob, chunk, star)
             )
-            self._taskq.put((payload, len(chunk)))
+            self._taskq.put((payload, (seq, base)))
         return result
 
     # -- public API --------------------------------------------------------
@@ -707,7 +720,9 @@ class Pool:
     def wait_workers(self, n: Optional[int] = None,
                      timeout: Optional[float] = None) -> bool:
         """Block until n (default: all) worker connections are up
-        (reference: fiber/pool.py:1405-1422)."""
+        (reference: fiber/pool.py:1405-1422). Starts the (normally lazy)
+        worker population if needed."""
+        self._start_worker_thread()
         n = n if n is not None else self._n_workers
         return self._result_ep.wait_for_peers(n, timeout)
 
@@ -861,19 +876,23 @@ class ResilientPool(Pool):
                     return
             if item is None:
                 continue
-            payload, nitems = item
-            head = serialization.loads(payload)
-            key = (head[1], head[2])  # (seq, base)
+            payload, key = item
             with self._pending_lock:
-                self._pending[ident][key] = (payload, nitems)
+                # The worker may have been reaped while we waited for a
+                # task — its pending table is gone and nobody would ever
+                # resubmit this chunk. Requeue for the next "ready".
+                if fiber_pid in self._reaped_pids:
+                    self._taskq.put(item)
+                    continue
+                self._pending.setdefault(ident, {})[key] = payload
             try:
                 self._task_ep.send(payload)
             except (TransportClosed, OSError):
                 # Requester died between asking and receiving; put the
                 # chunk back for the next "ready" and keep serving.
                 with self._pending_lock:
-                    self._pending[ident].pop(key, None)
-                self._taskq.put((payload, nitems))
+                    self._pending.get(ident, {}).pop(key, None)
+                self._taskq.put(item)
                 continue
 
     def _on_result(self, seq, base, values, ident) -> None:
@@ -889,12 +908,14 @@ class ResilientPool(Pool):
         with self._pending_lock:
             self._reaped_pids.add(pid)
             idents = self._pid_to_idents.pop(pid, set())
-            resubmit: List[Tuple[bytes, int]] = []
+            resubmit: List[Tuple[bytes, Tuple[int, int]]] = []
             for ident in idents:
                 table = self._pending.pop(ident, {})
-                resubmit.extend(table.values())
-        for payload, nitems in resubmit:
-            self._taskq.put((payload, nitems))
+                resubmit.extend(
+                    (payload, key) for key, payload in table.items()
+                )
+        for payload, key in resubmit:
+            self._taskq.put((payload, key))
         if resubmit:
             logger.info(
                 "resubmitted %d chunks from dead worker %s",

@@ -226,7 +226,13 @@ class Endpoint:
         with self._chan_lock:
             if chan in self._channels:
                 self._channels.remove(chan)
+            now_empty = not self._channels
         chan.close()
+        # A connected endpoint has no listener: losing its only channel is
+        # final, so wake blocked receivers with closure instead of letting
+        # them hang (multiprocessing raises EOFError here).
+        if now_empty and not self._is_bound and not self._closed:
+            self._inbox.put(_SENTINEL)
 
     # -- data path --------------------------------------------------------
     def send(self, payload: bytes, timeout: Optional[float] = None) -> None:

@@ -11,7 +11,12 @@
 import os
 import time
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-set (not setdefault): the environment ships JAX_PLATFORMS=axon and a
+# sitecustomize that registers the axon TPU-tunnel PJRT plugin in every
+# interpreter. Tests must run on the virtual 8-device CPU mesh, and child
+# processes must boot without the axon plugin at all.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -19,6 +24,12 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ.setdefault("FIBER_BACKEND", "local")
 os.environ.setdefault("FIBER_LOG_FILE", "/tmp/fiber_tpu_test.log")
+
+# sitecustomize already imported jax and registered axon in THIS
+# interpreter; route the config to cpu before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

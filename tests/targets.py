@@ -146,3 +146,67 @@ def pi_inside(n):
         if x * x + y * y <= 1.0:
             count += 1
     return count
+
+
+def manager_list_appender(proxy, n):
+    """Mutate a managed list from a remote process."""
+    for i in range(n):
+        proxy.append(i)
+
+
+def manager_queue_consumer(qproxy, out_q, n):
+    total = 0
+    for _ in range(n):
+        total += qproxy.get()
+    out_q.put(total)
+
+
+def slow_manager_call(x):
+    import time
+
+    time.sleep(1.0)
+    return x * 2
+
+
+class SlowWorker:
+    """User class registered on an AsyncManager (RL-env style)."""
+
+    def step(self, x):
+        import time
+
+        time.sleep(1.0)
+        return x + 100
+
+
+def ring_allreduce_check(rank, size):
+    """Each rank contributes rank+1; allreduce must equal sum(1..size)."""
+    import numpy as np
+
+    from fiber_tpu.parallel.ring import current_ring
+
+    ring = current_ring()
+    arr = np.full(257, float(rank + 1), dtype=np.float32)  # odd size: chunk
+    out = ring.allreduce(arr)
+    expected = size * (size + 1) / 2
+    assert np.allclose(out, expected), (rank, out[:4], expected)
+    mean = ring.allreduce(np.ones(4, dtype=np.float32), op="mean")
+    assert np.allclose(mean, 1.0)
+    ring.close()
+
+
+def ring_sgd_step(rank, size):
+    """Mini data-parallel SGD: per-rank gradient, ring-averaged update
+    (the reference's examples/ring.py workload without torch/gloo)."""
+    import numpy as np
+
+    from fiber_tpu.parallel.ring import current_ring
+
+    ring = current_ring()
+    w = np.zeros(8, dtype=np.float32)
+    for _ in range(3):
+        grad = np.full(8, float(rank + 1), dtype=np.float32)
+        avg = ring.allreduce(grad, op="mean")
+        w -= 0.1 * avg
+    expected = -0.3 * (size + 1) / 2
+    assert np.allclose(w, expected), (rank, w[0], expected)
+    ring.close()

@@ -1,0 +1,16 @@
+"""Ring topology + host collectives across real processes
+(reference: the Ring examples, examples/ring.py)."""
+
+import fiber_tpu  # noqa: F401
+from fiber_tpu.parallel import Ring
+from tests import targets
+
+
+def test_ring_allreduce_across_processes():
+    ring = Ring(3, targets.ring_allreduce_check)
+    ring.run()  # join() raises if any rank asserted
+
+
+def test_ring_data_parallel_sgd():
+    ring = Ring(2, targets.ring_sgd_step)
+    ring.run()

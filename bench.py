@@ -1,0 +1,143 @@
+"""Benchmark: ES policy-evaluations per second on the attached accelerator.
+
+Prints ONE JSON line:
+    {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+Workload (BASELINE.json north star): OpenAI-ES on CartPole-v1 with an MLP
+policy — full 500-step episode evaluations, antithetic perturbations drawn
+on-chip, centered-rank shaping, psum'd gradient. The north-star target is
+10,000 evals/sec on a v5e-64; ``vs_baseline`` is measured evals/sec divided
+by this chip's proportional share (10_000 / 64 per chip).
+
+Run ``python bench.py --platform cpu`` to exercise the same path on the
+virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+NORTH_STAR_EVALS_PER_SEC = 10_000.0
+NORTH_STAR_CHIPS = 64
+
+
+def _emit(result: dict) -> None:
+    print(json.dumps(result), flush=True)
+
+
+def _watchdog(seconds: float, payload: dict):
+    """Emit a failure line and hard-exit if the accelerator wedges."""
+
+    def fire():
+        _emit(payload)
+        os._exit(2)
+
+    timer = threading.Timer(seconds, fire)
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--platform", default="",
+                        help="force a jax platform (e.g. cpu)")
+    parser.add_argument("--pop", type=int, default=4096)
+    parser.add_argument("--steps", type=int, default=500,
+                        help="episode length (CartPole-v1 uses 500)")
+    parser.add_argument("--gens", type=int, default=10)
+    parser.add_argument("--init-timeout", type=float, default=600.0)
+    args = parser.parse_args()
+
+    metric = "es_policy_evals_per_sec"
+    fail_payload = {
+        "metric": metric,
+        "value": 0.0,
+        "unit": "evals/s",
+        "vs_baseline": 0.0,
+        "error": "accelerator backend initialization timed out",
+    }
+
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+        if args.platform == "cpu":
+            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+    watchdog = _watchdog(args.init_timeout, fail_payload)
+    import jax
+
+    if args.platform:
+        try:
+            jax.config.update("jax_platforms", args.platform)
+        except Exception:
+            pass
+
+    devices = jax.devices()
+    watchdog.cancel()
+
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from fiber_tpu.models import CartPole, MLPPolicy
+    from fiber_tpu.ops import EvolutionStrategy
+
+    mesh = Mesh(np.asarray(devices), ("pool",))
+    n_dev = len(devices)
+
+    policy = MLPPolicy(CartPole.obs_dim, CartPole.act_dim, hidden=(32, 32))
+
+    def eval_fn(theta, key):
+        return CartPole.rollout(policy.act, theta, key,
+                                max_steps=args.steps)
+
+    es = EvolutionStrategy(
+        eval_fn, dim=policy.dim, pop_size=args.pop, sigma=0.1, lr=0.03,
+        mesh=mesh,
+    )
+    params = policy.init(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(1)
+
+    # Warmup: compile + one real step.
+    compile_watchdog = _watchdog(
+        args.init_timeout,
+        {**fail_payload, "error": "compile/first-step timed out"},
+    )
+    key, k = jax.random.split(key)
+    params, stats = es.step(params, k)
+    jax.block_until_ready(stats)
+    compile_watchdog.cancel()
+
+    t0 = time.perf_counter()
+    for _ in range(args.gens):
+        key, k = jax.random.split(key)
+        params, stats = es.step(params, k)
+    jax.block_until_ready(stats)
+    elapsed = time.perf_counter() - t0
+
+    total_evals = es.pop_size * args.gens
+    evals_per_sec = total_evals / elapsed
+    per_chip_share = NORTH_STAR_EVALS_PER_SEC / NORTH_STAR_CHIPS
+    result = {
+        "metric": metric,
+        "value": round(evals_per_sec, 2),
+        "unit": "evals/s",
+        "vs_baseline": round(evals_per_sec / (per_chip_share * n_dev), 3),
+        "pop_size": es.pop_size,
+        "episode_steps": args.steps,
+        "generations": args.gens,
+        "n_devices": n_dev,
+        "platform": devices[0].platform,
+        "env_steps_per_sec": round(evals_per_sec * args.steps, 1),
+        "mean_fitness": float(jax.device_get(stats)[0]),
+    }
+    _emit(result)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

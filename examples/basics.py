@@ -1,0 +1,57 @@
+"""The smallest possible tour: Process, Queue, Pipe, Manager
+(reference: examples/basic_process.py, basic_queue.py, shared_data.py).
+
+Run:  python examples/basics.py
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+
+import sys
+
+
+def greeter(name, q):
+    q.put(f"hello {name} from a fiber_tpu process")
+
+
+def doubler(conn):
+    while True:
+        item = conn.recv()
+        if item is None:
+            return
+        conn.send(item * 2)
+
+
+def main():
+    import fiber_tpu
+
+    # Process + SimpleQueue
+    q = fiber_tpu.SimpleQueue()
+    p = fiber_tpu.Process(target=greeter, args=("world", q))
+    p.start()
+    print(q.get(30))
+    p.join(30)
+
+    # Pipe
+    here, there = fiber_tpu.Pipe()
+    p = fiber_tpu.Process(target=doubler, args=(there,))
+    p.start()
+    here.send(21)
+    print("21 doubled remotely ->", here.recv(30))
+    here.send(None)
+    p.join(30)
+
+    # Manager shared state
+    manager = fiber_tpu.Manager()
+    shopping = manager.list(["eggs"])
+    shopping.append("spam")
+    print("shared list ->", list(shopping))
+    manager.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,61 @@
+"""Queue micro-benchmark: msgs/sec + effective Mbps through a SimpleQueue
+between two processes (reference: examples/bench_queue.py).
+
+Run:  python examples/bench_queue.py [--msgs 20000] [--size 1024]
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+
+import argparse
+import sys
+import time
+
+
+def echo_worker(q_in, q_out, n):
+    for _ in range(n):
+        q_out.put(q_in.get())
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--msgs", type=int, default=20_000)
+    parser.add_argument("--size", type=int, default=1024)
+    args = parser.parse_args()
+
+    import fiber_tpu
+
+    q_in, q_out = fiber_tpu.SimpleQueue(), fiber_tpu.SimpleQueue()
+    p = fiber_tpu.Process(target=echo_worker,
+                          args=(q_in, q_out, args.msgs))
+    p.start()
+
+    payload = b"x" * args.size
+    t0 = time.time()
+    inflight = 0
+    sent = received = 0
+    while received < args.msgs:
+        while sent < args.msgs and inflight < 512:
+            q_in.put(payload)
+            sent += 1
+            inflight += 1
+        q_out.get()
+        received += 1
+        inflight -= 1
+    elapsed = time.time() - t0
+    p.join(30)
+
+    rate = args.msgs / elapsed
+    mbps = rate * args.size * 8 / 1e6
+    print(f"{args.msgs} round-trips of {args.size}B in {elapsed:.2f}s: "
+          f"{rate:,.0f} msgs/s, {mbps:,.1f} Mbps effective")
+    q_in.close()
+    q_out.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,59 @@
+"""OpenAI-ES on CartPole, fully on-device — the north-star workload
+(reference: examples/gecco-2020/es.py is a fiber.Pool(40).map loop; here
+the whole generation is one SPMD step on the mesh).
+
+Run:  python examples/es_cartpole.py [--pop 1024] [--gens 50]
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+
+import argparse
+import sys
+import time
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--pop", type=int, default=1024)
+    parser.add_argument("--gens", type=int, default=50)
+    parser.add_argument("--steps", type=int, default=500)
+    parser.add_argument("--hidden", type=int, default=32)
+    args = parser.parse_args()
+
+    import jax
+
+    from fiber_tpu.models import CartPole, MLPPolicy
+    from fiber_tpu.ops import EvolutionStrategy
+
+    policy = MLPPolicy(CartPole.obs_dim, CartPole.act_dim,
+                       hidden=(args.hidden, args.hidden))
+
+    def eval_fn(theta, key):
+        return CartPole.rollout(policy.act, theta, key,
+                                max_steps=args.steps)
+
+    es = EvolutionStrategy(eval_fn, dim=policy.dim, pop_size=args.pop,
+                           sigma=0.1, lr=0.03)
+    params = policy.init(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(1)
+
+    t0 = time.time()
+    params, history = es.run(params, key, generations=args.gens,
+                             log_every=max(1, args.gens // 10))
+    elapsed = time.time() - t0
+
+    for gen, mean, best in history:
+        print(f"gen {gen:4d}  mean {mean:8.2f}  best {best:8.2f}")
+    evals = es.pop_size * args.gens
+    print(f"{evals} policy evals in {elapsed:.1f}s "
+          f"= {evals / elapsed:,.0f} evals/s "
+          f"({evals * args.steps / elapsed:,.0f} env-steps/s)")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

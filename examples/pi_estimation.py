@@ -1,0 +1,73 @@
+"""Monte-Carlo pi estimation with Pool.map — the reference's hello-world
+workload (reference: examples/pi_estimation.py) plus the on-device variant.
+
+Run:  python examples/pi_estimation.py [--device]
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+
+import argparse
+import random
+import sys
+import time
+
+
+def inside(n):
+    count = 0
+    for _ in range(n):
+        x, y = random.random(), random.random()
+        if x * x + y * y <= 1.0:
+            count += 1
+    return count
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--workers", type=int, default=4)
+    parser.add_argument("--samples", type=int, default=200_000)
+    parser.add_argument("--device", action="store_true",
+                        help="run the jittable variant on the device mesh")
+    args = parser.parse_args()
+
+    import fiber_tpu
+
+    if args.device:
+        import jax
+        import jax.numpy as jnp
+
+        from fiber_tpu.meta import meta
+
+        @meta(device=True)
+        def inside_dev(seed):
+            key = jax.random.PRNGKey(seed.astype("int32"))
+            pts = jax.random.uniform(key, (args.samples, 2))
+            return (jnp.sum(pts[:, 0] ** 2 + pts[:, 1] ** 2 <= 1.0)
+                    .astype(jnp.float32))
+
+        import numpy as np
+
+        with fiber_tpu.Pool(args.workers) as pool:
+            t0 = time.time()
+            counts = pool.map(inside_dev, np.arange(args.workers * 4))
+            elapsed = time.time() - t0
+        total = float(sum(counts))
+        n = args.samples * args.workers * 4
+    else:
+        chunks = [args.samples // args.workers] * args.workers
+        with fiber_tpu.Pool(args.workers) as pool:
+            t0 = time.time()
+            counts = pool.map(inside, chunks)
+            elapsed = time.time() - t0
+        total = sum(counts)
+        n = sum(chunks)
+
+    print(f"pi ~= {4.0 * total / n:.6f}  ({n} samples, {elapsed:.2f}s)")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,274 @@
+"""TPU backend: jobs are processes on the TPU pod slice's VM hosts.
+
+Reference parity: this fills the slot of fiber/kubernetes_backend.py +
+docker_backend.py — one driver per cluster substrate — except the substrate
+is a TPU pod slice. Placement model (SURVEY.md §2 parallelism table): one
+framework process per TPU-VM host drives that host's local devices; jobs
+round-robin across hosts unless ``JobSpec.host_hint`` pins one.
+
+Host discovery, in priority order:
+
+1. ``tpu_hosts`` config / ``FIBER_TPU_HOSTS`` env: ``"ip[:port],..."`` —
+   explicit list (also how CI points at a simulated localhost cluster);
+2. ``sim:N``: spawn N local host agents (single-machine simulation of an
+   N-host slice, the Docker-backend role in the reference's test matrix);
+3. ``TPU_WORKER_HOSTNAMES`` env (set on real TPU-VMs by the platform).
+
+Each host runs a fiber_tpu host agent (fiber_tpu/host_agent.py); this
+backend is a thin RPC client over authenticated TCP.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from multiprocessing.connection import Client
+from typing import Dict, List, Optional, Tuple
+
+from fiber_tpu import config
+from fiber_tpu.core import Backend, Job, JobSpec, ProcessStatus
+from fiber_tpu.host_agent import DEFAULT_AGENT_PORT, cluster_authkey
+from fiber_tpu.utils.logging import get_logger
+from fiber_tpu.utils.net import find_listen_address
+
+logger = get_logger()
+
+
+class AgentClient:
+    """One authenticated connection per host agent, lock-serialized."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self.host = host
+        self.port = port
+        self._conn = None
+        self._lock = threading.Lock()
+
+    def call(self, op: str, *args):
+        with self._lock:
+            try:
+                if self._conn is None:
+                    self._conn = Client((self.host, self.port),
+                                        authkey=cluster_authkey())
+                self._conn.send((op, *args))
+                ok, payload = self._conn.recv()
+            except (OSError, EOFError):
+                # A failed round-trip poisons the stream (the next recv
+                # could read this call's late reply); drop the connection
+                # so the next call redials cleanly.
+                if self._conn is not None:
+                    try:
+                        self._conn.close()
+                    except OSError:
+                        pass
+                    self._conn = None
+                raise
+        if not ok:
+            raise RuntimeError(
+                f"agent {self.host}:{self.port} error: {payload}"
+            )
+        return payload
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                except OSError:
+                    pass
+                self._conn = None
+
+
+def _parse_hosts(spec: str) -> List[Tuple[str, int]]:
+    hosts = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            host, port_s = part.rsplit(":", 1)
+            if not host or not port_s.isdigit():
+                raise ValueError(
+                    f"malformed host entry {part!r} (want ip or ip:port)"
+                )
+            hosts.append((host, int(port_s)))
+        else:
+            hosts.append((part, DEFAULT_AGENT_PORT))
+    return hosts
+
+
+class TpuBackend(Backend):
+    name = "tpu"
+
+    def __init__(self) -> None:
+        cfg = config.get()
+        self._sim_agents: List[subprocess.Popen] = []
+        hosts_spec = cfg.tpu_hosts or os.environ.get("FIBER_TPU_HOSTS", "")
+        if hosts_spec.startswith("sim:"):
+            n = int(hosts_spec.split(":", 1)[1])
+            self._hosts = self._start_sim_cluster(n)
+        elif hosts_spec:
+            self._hosts = _parse_hosts(hosts_spec)
+        else:
+            names = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+            if not names:
+                raise RuntimeError(
+                    "tpu backend: no hosts (set tpu_hosts config, "
+                    "FIBER_TPU_HOSTS, or run on a pod slice with "
+                    "TPU_WORKER_HOSTNAMES)"
+                )
+            self._hosts = _parse_hosts(names)
+        if not self._hosts:
+            raise RuntimeError("tpu backend: empty host list")
+        self._agents: Dict[Tuple[str, int], AgentClient] = {}
+        self._rr = 0
+        self._lock = threading.Lock()
+        self._jobs: List[Job] = []
+        logger.info("tpu backend: %d host(s): %s", len(self._hosts),
+                    self._hosts)
+
+    # ------------------------------------------------------------------
+    def _start_sim_cluster(self, n: int) -> List[Tuple[str, int]]:
+        """N local agents simulating an N-host pod slice (loopback-only)."""
+        import atexit
+
+        # Registered before any spawn so a partial startup failure still
+        # reaps the agents that did come up.
+        atexit.register(self.shutdown_sim_cluster)
+        hosts = []
+        for _ in range(n):
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "fiber_tpu.host_agent",
+                 "--port", "0", "--announce", "--bind", "127.0.0.1"],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL,
+                text=True,
+            )
+            self._sim_agents.append(proc)
+            line = proc.stdout.readline().strip()
+            if not line.startswith("AGENT_PORT"):
+                self.shutdown_sim_cluster()
+                raise RuntimeError(
+                    f"sim agent failed to start (got {line!r})"
+                )
+            port = int(line.split()[1])
+            hosts.append(("127.0.0.1", port))
+        return hosts
+
+    def shutdown_sim_cluster(self) -> None:
+        for proc in self._sim_agents:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in self._sim_agents:
+            try:
+                proc.wait(5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self._sim_agents = []
+
+    def _agent(self, host: Tuple[str, int]) -> AgentClient:
+        with self._lock:
+            client = self._agents.get(host)
+            if client is None:
+                client = AgentClient(*host)
+                self._agents[host] = client
+            return client
+
+    def _pick_host(self, spec: JobSpec) -> Tuple[str, int]:
+        if spec.host_hint:
+            for host in self._hosts:
+                if host[0] == spec.host_hint or \
+                        f"{host[0]}:{host[1]}" == spec.host_hint:
+                    return host
+            raise ValueError(f"host_hint {spec.host_hint!r} not in cluster")
+        with self._lock:
+            host = self._hosts[self._rr % len(self._hosts)]
+            self._rr += 1
+        return host
+
+    # ------------------------------------------------------------------
+    def create_job(self, job_spec: JobSpec) -> Job:
+        host = self._pick_host(job_spec)
+        agent = self._agent(host)
+        env = dict(job_spec.env or {})
+        pid, log_path = agent.call(
+            "spawn", job_spec.command, job_spec.cwd, env, job_spec.name
+        )
+        job = Job({"host": host, "pid": pid, "log": log_path},
+                  jid=f"{host[0]}:{host[1]}/{pid}")
+        job.host = host[0]
+        with self._lock:
+            self._jobs.append(job)
+        return job
+
+    def _agent_for_job(self, job: Job) -> Tuple[AgentClient, int]:
+        data = job.data
+        return self._agent(data["host"]), data["pid"]
+
+    def get_job_status(self, job: Job) -> ProcessStatus:
+        agent, pid = self._agent_for_job(job)
+        rc = agent.call("poll", pid)
+        return ProcessStatus.STARTED if rc is None else ProcessStatus.STOPPED
+
+    def get_job_logs(self, job: Job) -> str:
+        agent, pid = self._agent_for_job(job)
+        return agent.call("logs", pid)
+
+    def wait_for_job(self, job: Job, timeout: Optional[float]) -> Optional[int]:
+        agent, pid = self._agent_for_job(job)
+        # Short bounded agent-side waits so one join never pins the shared
+        # agent channel (other RPCs to this host interleave between slices).
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            slice_ = 0.5
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return agent.call("poll", pid)
+                slice_ = min(slice_, remaining)
+            rc = agent.call("wait", pid, slice_)
+            if rc is not None:
+                return rc
+
+    def terminate_job(self, job: Job) -> None:
+        agent, pid = self._agent_for_job(job)
+        agent.call("signal", pid, int(signal.SIGTERM))
+
+    def kill_job(self, job: Job) -> None:
+        agent, pid = self._agent_for_job(job)
+        agent.call("signal", pid, int(signal.SIGKILL))
+
+    def get_listen_addr(self) -> Tuple[str, int, str]:
+        if all(h[0] in ("127.0.0.1", "localhost") for h in self._hosts):
+            return ("127.0.0.1", 0, "lo")
+        ip = find_listen_address() or "127.0.0.1"
+        return (ip, 0, "eth0")
+
+    def list_jobs(self) -> List[Job]:
+        with self._lock:
+            jobs = list(self._jobs)
+        live = []
+        for job in jobs:
+            try:
+                if self.get_job_status(job) == ProcessStatus.STARTED:
+                    live.append(job)
+            except Exception:
+                pass
+        return live
+
+    # -- file staging (fiber cp parity) --------------------------------
+    def put_file(self, path: str, data: bytes, hosts=None,
+                 mode: int = 0o644) -> None:
+        for host in (hosts or self._hosts):
+            self._agent(host).call("put_file", path, data, mode)
+
+    def get_file(self, path: str, host=None) -> bytes:
+        host = host or self._hosts[0]
+        return self._agent(host).call("get_file", path)
+
+
+def make_backend() -> TpuBackend:
+    return TpuBackend()

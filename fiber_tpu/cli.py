@@ -1,0 +1,224 @@
+"""The ``fiber-tpu`` command-line tool.
+
+Reference parity: fiber/cli.py (``fiber run`` builds an image and launches
+the master in the cluster; ``fiber cp`` stages files through a PVC pod).
+The TPU-native equivalents drive pod-slice host agents instead of a
+container platform:
+
+=============  ==========================================================
+run            run a user program with the framework configured
+               (``--backend``, ``--hosts``; the program's fiber_tpu
+               Processes land on the cluster)
+sim            run a user program against a simulated N-host cluster on
+               this machine (the Docker-backend role in the reference's
+               test matrix)
+agent          run the per-host agent daemon (started on every TPU-VM)
+up             print (or execute) the commands that start agents on every
+               host of a pod slice via gcloud ssh
+status         ping every host agent and report liveness/host info
+cp             stage files to/from hosts through the agents
+=============  ==========================================================
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import shlex
+import subprocess
+import sys
+from typing import List, Optional
+
+
+def _hosts_from_args(args) -> str:
+    hosts = args.hosts or os.environ.get("FIBER_TPU_HOSTS", "")
+    if not hosts:
+        raise SystemExit("error: --hosts (or FIBER_TPU_HOSTS) is required")
+    return hosts
+
+
+def _parse_hosts_cli(spec: str):
+    from fiber_tpu.backends.tpu import _parse_hosts
+
+    try:
+        return _parse_hosts(spec)
+    except ValueError as err:
+        raise SystemExit(f"error: {err}") from None
+
+
+def _run_script(script: str, script_args: List[str]) -> None:
+    sys.argv = [script] + list(script_args)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(script)) or ".")
+    runpy.run_path(script, run_name="__main__")
+
+
+def cmd_run(args) -> int:
+    if args.backend:
+        os.environ["FIBER_BACKEND"] = args.backend
+    if args.hosts:
+        os.environ["FIBER_TPU_HOSTS"] = args.hosts
+        os.environ.setdefault("FIBER_BACKEND", "tpu")
+    _run_script(args.script, args.script_args)
+    return 0
+
+
+def cmd_sim(args) -> int:
+    os.environ["FIBER_BACKEND"] = "tpu"
+    os.environ["FIBER_TPU_HOSTS"] = f"sim:{args.n}"
+    _run_script(args.script, args.script_args)
+    return 0
+
+
+def cmd_agent(args) -> int:
+    from fiber_tpu import host_agent
+
+    argv = ["--port", str(args.port)]
+    if args.announce:
+        argv.append("--announce")
+    return host_agent.main(argv)
+
+
+def cmd_up(args) -> int:
+    """Emit (or run) agent-start commands for every pod-slice host."""
+    from fiber_tpu.host_agent import DEFAULT_AGENT_PORT
+
+    port = args.port or DEFAULT_AGENT_PORT
+    key_prefix = ""
+    if os.environ.get("FIBER_CLUSTER_KEY"):
+        # Agents must share the operator's cluster key or every later
+        # master/status/cp call fails HMAC auth.
+        key_prefix = (
+            f"FIBER_CLUSTER_KEY={shlex.quote(os.environ['FIBER_CLUSTER_KEY'])} "
+        )
+    agent_cmd = (
+        f"{key_prefix}nohup {args.python} -m fiber_tpu.host_agent "
+        f"--port {port} >/tmp/fiber-agent.log 2>&1 &"
+    )
+    if args.tpu:
+        base = (
+            f"gcloud compute tpus tpu-vm ssh {shlex.quote(args.tpu)} "
+            + (f"--zone {shlex.quote(args.zone)} " if args.zone else "")
+            + "--worker all --command "
+        )
+        full = base + shlex.quote(agent_cmd)
+        print(full)
+        if args.execute:
+            return subprocess.call(full, shell=True)
+        print("# dry run — pass --execute to run", file=sys.stderr)
+        return 0
+    for host in _hosts_from_args(args).split(","):
+        host = host.strip().split(":")[0]
+        full = f"ssh {host} {shlex.quote(agent_cmd)}"
+        print(full)
+        if args.execute:
+            rc = subprocess.call(full, shell=True)
+            if rc != 0:
+                return rc
+    if not args.execute:
+        print("# dry run — pass --execute to run", file=sys.stderr)
+    return 0
+
+
+def cmd_status(args) -> int:
+    from fiber_tpu.backends.tpu import AgentClient
+
+    rc = 0
+    for host, port in _parse_hosts_cli(_hosts_from_args(args)):
+        client = AgentClient(host, port)
+        try:
+            client.call("ping")
+            info = client.call("host_info")
+            jobs = client.call("list_jobs")
+            print(f"{host}:{port}  up  cpus={info['cpu_count']} "
+                  f"live_jobs={len(jobs)} python={info['python']}")
+        except Exception as err:
+            print(f"{host}:{port}  DOWN  ({err})")
+            rc = 1
+        finally:
+            client.close()
+    return rc
+
+
+def cmd_cp(args) -> int:
+    """Stage files: local -> all hosts, or host:path -> local.
+
+    Reference parity: fiber/cli.py:112-170 (``fiber cp`` via PVC pod).
+    """
+    from fiber_tpu.backends.tpu import AgentClient
+
+    hosts = _parse_hosts_cli(_hosts_from_args(args))
+    if ":" in args.src and not os.path.exists(args.src):
+        host_part, path = args.src.split(":", 1)
+        matches = [h for h in hosts if h[0] == host_part]
+        if not matches:
+            raise SystemExit(f"error: host {host_part!r} not in --hosts")
+        client = AgentClient(*matches[0])
+        data = client.call("get_file", path)
+        with open(args.dst, "wb") as fh:
+            fh.write(data)
+        print(f"fetched {len(data)} bytes from {args.src} -> {args.dst}")
+        return 0
+    with open(args.src, "rb") as fh:
+        data = fh.read()
+    mode = os.stat(args.src).st_mode & 0o777
+    for host in hosts:
+        AgentClient(*host).call("put_file", args.dst, data, mode)
+        print(f"staged {args.src} -> {host[0]}:{args.dst} ({len(data)} bytes)")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="fiber-tpu",
+        description="TPU-native distributed computing framework CLI",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("run", help="run a program on the cluster")
+    p.add_argument("--backend", default="")
+    p.add_argument("--hosts", default="")
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("sim", help="run against a simulated N-host cluster")
+    p.add_argument("n", type=int)
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_sim)
+
+    p = sub.add_parser("agent", help="run the per-host agent daemon")
+    p.add_argument("--port", type=int, default=7060)
+    p.add_argument("--announce", action="store_true")
+    p.set_defaults(fn=cmd_agent)
+
+    p = sub.add_parser("up", help="start agents on every pod-slice host")
+    p.add_argument("--hosts", default="")
+    p.add_argument("--tpu", default="", help="TPU name (gcloud ssh path)")
+    p.add_argument("--zone", default="")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--python", default="python3")
+    p.add_argument("--execute", action="store_true")
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("status", help="ping every host agent")
+    p.add_argument("--hosts", default="")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("cp", help="stage files to/from hosts")
+    p.add_argument("src")
+    p.add_argument("dst")
+    p.add_argument("--hosts", default="")
+    p.set_defaults(fn=cmd_cp)
+
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,253 @@
+"""Host agent: the per-host daemon the TPU backend drives.
+
+On a real pod slice one agent runs on every TPU-VM host
+(``python -m fiber_tpu.host_agent --port 7060``, e.g. from the ``fiber-tpu
+up`` CLI or a startup script); the master's ``tpu`` backend dials each
+agent and asks it to spawn/poll/wait/signal framework processes and to
+stage files. This replaces the reference's cluster drivers (Docker daemon /
+K8s API — fiber/docker_backend.py, fiber/kubernetes_backend.py) with a
+self-contained, zero-dependency control plane over authenticated TCP
+(multiprocessing.connection with HMAC auth, like the managers plane).
+
+The same agent binary doubles as the **simulated cluster** for CI: N agents
+on localhost behave exactly like N pod hosts (reference test strategy §4 —
+multi-node simulated on one machine).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from multiprocessing.connection import Listener
+from typing import Any, Dict, Optional, Tuple
+
+DEFAULT_AGENT_PORT = 7060
+
+
+def cluster_authkey() -> bytes:
+    """Shared-secret for agent auth: FIBER_CLUSTER_KEY env or a
+    well-known development default."""
+    return os.environ.get("FIBER_CLUSTER_KEY", "fiber-tpu-cluster").encode()
+
+
+class _AgentJob:
+    def __init__(self, proc: subprocess.Popen, log_path: str) -> None:
+        self.proc = proc
+        self.log_path = log_path
+
+
+#: Completed-job records kept before the oldest are pruned (their logs too).
+MAX_FINISHED_JOBS = 1024
+
+
+class HostAgent:
+    """Serves spawn/poll/wait/logs/signal/put_file requests."""
+
+    def __init__(self, port: int, authkey: Optional[bytes] = None,
+                 bind: str = "0.0.0.0") -> None:
+        if (bind not in ("127.0.0.1", "localhost")
+                and "FIBER_CLUSTER_KEY" not in os.environ):
+            print(
+                "fiber-tpu agent WARNING: binding non-loopback with the "
+                "default cluster key; set FIBER_CLUSTER_KEY on every host "
+                "(the default key is public knowledge).",
+                file=sys.stderr, flush=True,
+            )
+        self._listener = Listener(
+            (bind, port), authkey=authkey or cluster_authkey()
+        )
+        self.port = self._listener.address[1]
+        # Jobs are keyed by a monotonically increasing id, never the OS
+        # pid — pid reuse must not alias a finished job's record.
+        self._jobs: Dict[int, _AgentJob] = {}
+        self._next_jid = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+
+    def serve_forever(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                break
+            threading.Thread(
+                target=self._serve, args=(conn,),
+                name="fiber-agent-conn", daemon=True,
+            ).start()
+
+    def _serve(self, conn) -> None:
+        try:
+            while True:
+                request = conn.recv()
+                try:
+                    result = self._dispatch(*request)
+                except SystemExit:
+                    conn.send((True, None))
+                    raise
+                except BaseException as exc:  # noqa: BLE001
+                    conn.send((False, repr(exc)))
+                    continue
+                conn.send((True, result))
+        except (EOFError, OSError):
+            pass
+        except SystemExit:
+            os._exit(0)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------------
+    def _dispatch(self, op: str, *args: Any) -> Any:
+        handler = getattr(self, "_op_" + op, None)
+        if handler is None:
+            raise ValueError(f"unknown agent op {op!r}")
+        return handler(*args)
+
+    def _op_ping(self) -> str:
+        return "pong"
+
+    def _op_spawn(self, command, cwd, env, name) -> Tuple[int, str]:
+        log_fd, log_path = tempfile.mkstemp(
+            prefix=f"fiber-agent-{name or 'job'}-", suffix=".log"
+        )
+        full_env = dict(os.environ)
+        full_env.update(env or {})
+        proc = subprocess.Popen(
+            list(command),
+            cwd=cwd if cwd and os.path.isdir(cwd) else None,
+            env=full_env,
+            stdout=log_fd,
+            stderr=subprocess.STDOUT,
+        )
+        os.close(log_fd)
+        with self._lock:
+            self._next_jid += 1
+            jid = self._next_jid
+            self._jobs[jid] = _AgentJob(proc, log_path)
+        self._prune_finished()
+        return jid, log_path
+
+    def _prune_finished(self) -> None:
+        """Bound the job table on long-lived agents: drop the oldest
+        finished records (and their log files) past MAX_FINISHED_JOBS."""
+        with self._lock:
+            finished = [
+                (jid, j) for jid, j in self._jobs.items()
+                if j.proc.poll() is not None
+            ]
+            excess = len(finished) - MAX_FINISHED_JOBS
+            victims = sorted(finished)[:excess] if excess > 0 else []
+            for jid, _ in victims:
+                del self._jobs[jid]
+        for _, job in victims:
+            try:
+                os.unlink(job.log_path)
+            except OSError:
+                pass
+
+    def _job(self, jid: int) -> _AgentJob:
+        with self._lock:
+            job = self._jobs.get(jid)
+        if job is None:
+            raise KeyError(f"no such job {jid}")
+        return job
+
+    def _op_poll(self, jid: int) -> Optional[int]:
+        return self._job(jid).proc.poll()
+
+    def _op_wait(self, jid: int, timeout: Optional[float]) -> Optional[int]:
+        try:
+            return self._job(jid).proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            return None
+
+    def _op_signal(self, jid: int, signum: int) -> bool:
+        job = self._job(jid)
+        if job.proc.poll() is None:
+            job.proc.send_signal(signum)
+            return True
+        return False
+
+    def _op_logs(self, jid: int, max_bytes: int = 65536) -> str:
+        job = self._job(jid)
+        try:
+            with open(job.log_path, "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                size = fh.tell()
+                fh.seek(max(0, size - max_bytes))
+                return fh.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+    def _op_list_jobs(self) -> list:
+        with self._lock:
+            return [
+                jid for jid, j in self._jobs.items()
+                if j.proc.poll() is None
+            ]
+
+    def _op_put_file(self, path: str, data: bytes, mode: int = 0o644) -> int:
+        """File staging — the ``fiber cp`` equivalent (reference:
+        fiber/cli.py:112-170 copies through a PVC pod)."""
+        directory = os.path.dirname(path)
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+        with open(path, "wb") as fh:
+            fh.write(data)
+        os.chmod(path, mode)
+        return len(data)
+
+    def _op_get_file(self, path: str) -> bytes:
+        with open(path, "rb") as fh:
+            return fh.read()
+
+    def _op_host_info(self) -> dict:
+        return {
+            "pid": os.getpid(),
+            "cpu_count": os.cpu_count(),
+            "cwd": os.getcwd(),
+            "python": sys.executable,
+        }
+
+    def _op_shutdown(self) -> None:
+        self._stop.set()
+        # reap children first
+        with self._lock:
+            jobs = list(self._jobs.values())
+        for job in jobs:
+            if job.proc.poll() is None:
+                job.proc.terminate()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        raise SystemExit(0)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="fiber_tpu.host_agent")
+    parser.add_argument("--port", type=int, default=DEFAULT_AGENT_PORT)
+    parser.add_argument("--bind", default="0.0.0.0",
+                        help="interface to bind (sim clusters: 127.0.0.1)")
+    parser.add_argument("--announce", action="store_true",
+                        help="print the bound port to stdout once serving")
+    args = parser.parse_args(argv)
+    agent = HostAgent(args.port, bind=args.bind)
+    if args.announce:
+        print(f"AGENT_PORT {agent.port}", flush=True)
+    # Die with the parent where supported (sim clusters).
+    signal.signal(signal.SIGTERM, lambda *_: os._exit(0))
+    agent.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

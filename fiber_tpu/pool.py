@@ -529,18 +529,41 @@ class Pool:
             # cpu_per_job sub-workers each, the last one the remainder.
             covered = sum(getattr(p, "_n_local", 1) for p in self._workers)
         missing_subs = self._n_workers - covered
+        if missing_subs <= 0:
+            return
+        # Respawning continues through a close() drain (resubmitted chunks
+        # need somewhere to run) and stops only once drained.
+        if self._terminated or self._draining_done():
+            return
+        plan = []
         while missing_subs > 0:
-            # Respawning continues through a close() drain (resubmitted
-            # chunks need somewhere to run) and stops only once drained.
-            if self._terminated or self._draining_done():
-                return
             n_local = min(self._cpu_per_job, missing_subs)
+            plan.append(n_local)
+            missing_subs -= n_local
+        # Spawn concurrently: worker launch is ~1s of interpreter boot +
+        # handshake each, and serial spawn would put that on the critical
+        # path of the first map. Each thread registers (or reaps) its own
+        # worker, so a spawn outliving the pacing join below can never
+        # leave an untracked live process, and a terminate() that raced
+        # the spawn reaps it immediately.
+        def spawn_one(n_local: int) -> None:
             p = self._spawn_worker(n_local)
             if p is None:
-                break  # transient backend failure: retry on the next tick
+                return
             with self._workers_lock:
-                self._workers.append(p)
-            missing_subs -= n_local
+                if not self._terminated:
+                    self._workers.append(p)
+                    return
+            p.terminate()
+
+        threads = [
+            threading.Thread(target=spawn_one, args=(n,), daemon=True)
+            for n in plan
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
 
     def _on_worker_death(self, proc) -> None:
         logger.debug("pool worker %s died", proc.name)

@@ -1,0 +1,95 @@
+"""CLI surface (reference: fiber/cli.py behavior, TPU-flavored)."""
+
+import subprocess
+import sys
+
+import pytest
+
+from fiber_tpu.cli import build_parser, main
+
+
+def test_parser_subcommands():
+    parser = build_parser()
+    for cmd in ("run", "sim", "agent", "up", "status", "cp"):
+        args = {
+            "run": ["run", "x.py"],
+            "sim": ["sim", "2", "x.py"],
+            "agent": ["agent"],
+            "up": ["up", "--hosts", "a,b"],
+            "status": ["status", "--hosts", "a"],
+            "cp": ["cp", "a", "b", "--hosts", "h"],
+        }[cmd]
+        parsed = parser.parse_args(args)
+        assert parsed.command == cmd
+
+
+def test_up_dry_run(capsys):
+    rc = main(["up", "--hosts", "10.0.0.1,10.0.0.2", "--port", "7070"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.count("ssh") == 2
+    assert "--port 7070" in out
+
+
+def test_up_gcloud_dry_run(capsys):
+    rc = main(["up", "--tpu", "my-pod", "--zone", "us-central2-b"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "gcloud compute tpus tpu-vm ssh" in out
+    assert "--worker all" in out
+
+
+def test_status_down_host(capsys):
+    rc = main(["status", "--hosts", "127.0.0.1:1"])  # nothing listening
+    assert rc == 1
+    assert "DOWN" in capsys.readouterr().out
+
+
+def test_status_and_cp_against_sim_agent(tmp_path, capsys):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fiber_tpu.host_agent", "--port", "0",
+         "--announce"],
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        port = int(proc.stdout.readline().split()[1])
+        hosts = f"127.0.0.1:{port}"
+
+        rc = main(["status", "--hosts", hosts])
+        assert rc == 0
+        assert "up" in capsys.readouterr().out
+
+        src = tmp_path / "src.txt"
+        src.write_text("stage me")
+        dst = str(tmp_path / "dst.txt")
+        rc = main(["cp", str(src), dst, "--hosts", hosts])
+        assert rc == 0
+        assert open(dst).read() == "stage me"
+
+        fetched = str(tmp_path / "fetched.txt")
+        rc = main(["cp", f"127.0.0.1:{dst}", fetched, "--hosts", hosts])
+        assert rc == 0
+        assert open(fetched).read() == "stage me"
+    finally:
+        proc.terminate()
+        proc.wait(10)
+
+
+def test_sim_runs_script(tmp_path):
+    script = tmp_path / "prog.py"
+    out = tmp_path / "out.txt"
+    script.write_text(
+        "import fiber_tpu, sys\n"
+        "def w(path):\n"
+        "    open(path, 'w').write('ran on sim cluster')\n"
+        "if __name__ == '__main__':\n"
+        f"    p = fiber_tpu.Process(target=w, args=({str(out)!r},))\n"
+        "    p.start(); p.join(60)\n"
+        "    assert p.exitcode == 0\n"
+    )
+    result = subprocess.run(
+        [sys.executable, "-m", "fiber_tpu.cli", "sim", "2", str(script)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert out.read_text() == "ran on sim cluster"

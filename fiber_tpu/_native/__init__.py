@@ -1,0 +1,156 @@
+"""Native extension loader: builds pump.cpp on first use with the system
+g++ (no pip involved), caches the .so next to the source, and exposes a
+ctypes binding. ``FIBER_NATIVE=0`` disables the native path entirely; every
+consumer has a pure-Python fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "pump.cpp")
+_SO = os.path.join(_HERE, "libfiberpump.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+_lock = threading.Lock()
+
+
+def _build() -> bool:
+    """Compile under an exclusive file lock: many processes (concurrent
+    pool-worker spawns) may race here, and exactly one must publish the
+    .so atomically (per-pid temp name + os.replace)."""
+    import fcntl
+
+    cxx = os.environ.get("CXX", "g++")
+    tmp = f"{_SO}.tmp.{os.getpid()}"
+    lock_path = _SO + ".lock"
+    try:
+        lock_fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+    except OSError:
+        return False
+    try:
+        fcntl.flock(lock_fd, fcntl.LOCK_EX)
+        if _so_fresh():
+            return True  # another process already built it
+        proc = subprocess.run(
+            [cxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+             _SRC, "-o", tmp],
+            capture_output=True, text=True, timeout=120,
+        )
+        if proc.returncode != 0:
+            from fiber_tpu.utils.logging import get_logger
+
+            get_logger().warning(
+                "native pump build failed; using the Python pump:\n%s",
+                proc.stderr[-2000:],
+            )
+            return False
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        try:
+            fcntl.flock(lock_fd, fcntl.LOCK_UN)
+        except OSError:
+            pass
+        os.close(lock_fd)
+
+
+def _so_fresh() -> bool:
+    return os.path.exists(_SO) and (
+        not os.path.exists(_SRC)
+        or os.path.getmtime(_SRC) <= os.path.getmtime(_SO)
+    )
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The pump library, building it if needed; None if unavailable."""
+    global _lib, _load_attempted
+    if os.environ.get("FIBER_NATIVE", "1") in ("0", "false"):
+        return None
+    with _lock:
+        if _load_attempted:
+            return _lib
+        _load_attempted = True
+        if not _so_fresh():
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            # A corrupt artifact must not poison future runs.
+            try:
+                os.unlink(_SO)
+            except OSError:
+                pass
+            return None
+        lib.fiber_pump_create.restype = ctypes.c_void_p
+        lib.fiber_pump_create.argtypes = [
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.fiber_pump_close.restype = None
+        lib.fiber_pump_close.argtypes = [ctypes.c_void_p]
+        lib.fiber_pump_peers.restype = ctypes.c_int
+        lib.fiber_pump_peers.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+class NativePump:
+    """One native device: two bound ports + an epoll forwarder thread in
+    C++. Speaks the transport wire protocol exactly."""
+
+    def __init__(self, duplex: bool) -> None:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native pump unavailable")
+        in_port = ctypes.c_int(0)
+        out_port = ctypes.c_int(0)
+        handle = lib.fiber_pump_create(
+            1 if duplex else 0,
+            ctypes.byref(in_port),
+            ctypes.byref(out_port),
+        )
+        if not handle:
+            raise RuntimeError("fiber_pump_create failed")
+        self._lib = lib
+        self._handle = handle
+        self.in_port = in_port.value
+        self.out_port = out_port.value
+
+    def peers(self, side: str) -> int:
+        """Live connection count: side 'in' (producers) or 'out'
+        (consumers)."""
+        if not self._handle:
+            return 0
+        return self._lib.fiber_pump_peers(
+            self._handle, 0 if side == "in" else 1
+        )
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.fiber_pump_close(self._handle)
+            self._handle = None
+
+    def __del__(self) -> None:  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def available() -> bool:
+    return load() is not None

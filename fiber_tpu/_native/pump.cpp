@@ -1,0 +1,374 @@
+// fiber_tpu native device pump.
+//
+// The device/forwarder is the hot loop under every queue and pipe: frames
+// arrive from producers on the in-side and are forwarded to consumers on
+// the out-side, round-robin, gated on consumer credit. The reference runs
+// nanomsg's C nn_device here (fiber/socket.py:297-320); this is the
+// fiber_tpu equivalent: a single epoll thread per device, zero Python in
+// the data path, speaking the same wire protocol as the Python transport
+// (8-byte big-endian frame length, then a 1-byte type tag: 0x00 data /
+// 0x01 credit + 4-byte big-endian count).
+//
+// Built with g++ -O2 -shared -fPIC; loaded via ctypes
+// (fiber_tpu/_native/__init__.py). Python endpoints remain the fallback.
+
+#include <arpa/inet.h>
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kCreditWindow = 4096;  // matches transport/tcp.py
+constexpr uint8_t kData = 0x00;
+constexpr uint8_t kCredit = 0x01;
+constexpr size_t kReadChunk = 1 << 16;
+
+uint64_t be64(const uint8_t* p) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; i++) v = (v << 8) | p[i];
+  return v;
+}
+
+void put_be64(uint8_t* p, uint64_t v) {
+  for (int i = 7; i >= 0; i--) { p[i] = v & 0xff; v >>= 8; }
+}
+
+uint32_t be32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+std::vector<uint8_t> credit_frame(uint32_t n) {
+  std::vector<uint8_t> f(8 + 1 + 4);
+  put_be64(f.data(), 5);
+  f[8] = kCredit;
+  f[9] = (n >> 24) & 0xff; f[10] = (n >> 16) & 0xff;
+  f[11] = (n >> 8) & 0xff; f[12] = n & 0xff;
+  return f;
+}
+
+struct Conn {
+  int fd = -1;
+  bool in_side = false;          // accepted on the in-listener
+  // read state machine
+  std::vector<uint8_t> rbuf;
+  size_t rpos = 0;               // consumed offset into rbuf
+  // write queue
+  std::deque<std::vector<uint8_t>> wq;
+  size_t woff = 0;
+  uint64_t credit = 0;           // consumer credit (out-side, non-duplex)
+  bool dead = false;
+};
+
+struct PendingFrame {
+  std::vector<uint8_t> wire;     // full frame incl. header+type
+  int source_fd;                 // for credit replenish (-1 = none)
+};
+
+struct Device {
+  int epfd = -1;
+  int in_listen = -1, out_listen = -1;
+  int wake_r = -1, wake_w = -1;
+  bool duplex = false;
+  std::unordered_map<int, Conn*> conns;
+  std::vector<int> in_fds, out_fds;
+  std::deque<PendingFrame> fifo_fwd;   // in -> out
+  std::deque<PendingFrame> fifo_rev;   // out -> in (duplex only)
+  size_t rr_fwd = 0, rr_rev = 0;
+  std::atomic<bool> stop{false};
+  std::atomic<int> n_in{0}, n_out{0};
+  std::thread thr;
+};
+
+int make_listener(int* port_out) {
+  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = 0;
+  if (bind(fd, (sockaddr*)&addr, sizeof addr) < 0 || listen(fd, 512) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  socklen_t len = sizeof addr;
+  getsockname(fd, (sockaddr*)&addr, &len);
+  *port_out = ntohs(addr.sin_port);
+  return fd;
+}
+
+void epoll_update(Device* d, Conn* c) {
+  epoll_event ev{};
+  ev.data.fd = c->fd;
+  ev.events = EPOLLIN | (c->wq.empty() ? 0 : EPOLLOUT);
+  epoll_ctl(d->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+void queue_write(Device* d, Conn* c, std::vector<uint8_t> buf) {
+  bool was_empty = c->wq.empty();
+  c->wq.push_back(std::move(buf));
+  if (was_empty) epoll_update(d, c);
+}
+
+void drop_conn(Device* d, int fd);
+
+// Move pending frames to credited consumers, round-robin.
+void pump_fifo(Device* d, std::deque<PendingFrame>& fifo,
+               std::vector<int>& targets, size_t& rr, bool use_credit) {
+  while (!fifo.empty() && !targets.empty()) {
+    Conn* chosen = nullptr;
+    size_t n = targets.size();
+    for (size_t step = 1; step <= n; step++) {
+      size_t idx = (rr + step) % n;
+      auto it = d->conns.find(targets[idx]);
+      if (it == d->conns.end() || it->second->dead) continue;
+      Conn* cand = it->second;
+      if (!use_credit || cand->credit > 0) {
+        chosen = cand;
+        rr = idx;
+        break;
+      }
+    }
+    if (chosen == nullptr) return;  // nobody ready; wait for credit/conn
+    PendingFrame pf = std::move(fifo.front());
+    fifo.pop_front();
+    if (use_credit) {
+      chosen->credit--;
+      // replenish the producer's standing window as its frame departs
+      auto sit = d->conns.find(pf.source_fd);
+      if (sit != d->conns.end() && !sit->second->dead) {
+        queue_write(d, sit->second, credit_frame(1));
+      }
+    }
+    queue_write(d, chosen, std::move(pf.wire));
+  }
+}
+
+void pump_all(Device* d) {
+  pump_fifo(d, d->fifo_fwd, d->out_fds, d->rr_fwd, !d->duplex);
+  if (d->duplex) {
+    pump_fifo(d, d->fifo_rev, d->in_fds, d->rr_rev, false);
+  }
+}
+
+void handle_frame(Device* d, Conn* c, const uint8_t* body, uint64_t blen,
+                  const uint8_t* wire, uint64_t wlen) {
+  if (blen >= 1 && body[0] == kCredit) {
+    if (blen >= 5) c->credit += be32(body + 1);
+    pump_all(d);
+    return;
+  }
+  PendingFrame pf;
+  pf.wire.assign(wire, wire + wlen);
+  pf.source_fd = c->fd;
+  if (c->in_side) {
+    d->fifo_fwd.push_back(std::move(pf));
+  } else if (d->duplex) {
+    d->fifo_rev.push_back(std::move(pf));
+  }  // data frames from consumers in non-duplex mode: ignore
+  pump_all(d);
+}
+
+void on_readable(Device* d, Conn* c) {
+  for (;;) {
+    size_t old = c->rbuf.size();
+    c->rbuf.resize(old + kReadChunk);
+    ssize_t got = ::read(c->fd, c->rbuf.data() + old, kReadChunk);
+    if (got < 0) {
+      c->rbuf.resize(old);
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      drop_conn(d, c->fd);
+      return;
+    }
+    if (got == 0) {
+      c->rbuf.resize(old);
+      drop_conn(d, c->fd);
+      return;
+    }
+    c->rbuf.resize(old + size_t(got));
+    if (size_t(got) < kReadChunk) break;
+  }
+  // parse complete frames
+  size_t pos = c->rpos;
+  for (;;) {
+    if (c->rbuf.size() - pos < 8) break;
+    uint64_t flen = be64(c->rbuf.data() + pos);
+    if (c->rbuf.size() - pos < 8 + flen) break;
+    handle_frame(d, c, c->rbuf.data() + pos + 8, flen,
+                 c->rbuf.data() + pos, 8 + flen);
+    pos += 8 + flen;
+    // c may have been dropped by handle_frame side effects
+    if (d->conns.find(c->fd) == d->conns.end()) return;
+  }
+  c->rpos = pos;
+  if (c->rpos > (1 << 20) || c->rpos == c->rbuf.size()) {
+    c->rbuf.erase(c->rbuf.begin(), c->rbuf.begin() + c->rpos);
+    c->rpos = 0;
+  }
+}
+
+void on_writable(Device* d, Conn* c) {
+  while (!c->wq.empty()) {
+    auto& buf = c->wq.front();
+    ssize_t sent = ::write(c->fd, buf.data() + c->woff,
+                           buf.size() - c->woff);
+    if (sent < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      drop_conn(d, c->fd);
+      return;
+    }
+    c->woff += size_t(sent);
+    if (c->woff == buf.size()) {
+      c->wq.pop_front();
+      c->woff = 0;
+    }
+  }
+  epoll_update(d, c);
+}
+
+void drop_conn(Device* d, int fd) {
+  auto it = d->conns.find(fd);
+  if (it == d->conns.end()) return;
+  Conn* c = it->second;
+  c->dead = true;
+  epoll_ctl(d->epfd, EPOLL_CTL_DEL, fd, nullptr);
+  ::close(fd);
+  d->conns.erase(it);
+  auto scrub = [fd](std::vector<int>& v) {
+    for (size_t i = 0; i < v.size(); i++) {
+      if (v[i] == fd) { v.erase(v.begin() + i); break; }
+    }
+  };
+  scrub(d->in_fds);
+  scrub(d->out_fds);
+  (c->in_side ? d->n_in : d->n_out).fetch_sub(1);
+  delete c;
+}
+
+void on_accept(Device* d, int listen_fd, bool in_side) {
+  for (;;) {
+    int fd = accept4(listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
+    if (fd < 0) return;
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    Conn* c = new Conn();
+    c->fd = fd;
+    c->in_side = in_side;
+    d->conns[fd] = c;
+    (in_side ? d->in_fds : d->out_fds).push_back(fd);
+    (in_side ? d->n_in : d->n_out).fetch_add(1);
+    epoll_event ev{};
+    ev.data.fd = fd;
+    ev.events = EPOLLIN;
+    epoll_ctl(d->epfd, EPOLL_CTL_ADD, fd, &ev);
+    if (in_side && !d->duplex) {
+      // producers get a standing credit window (bound r-endpoint role)
+      queue_write(d, c, credit_frame(uint32_t(kCreditWindow)));
+    }
+    pump_all(d);
+  }
+}
+
+void run_loop(Device* d) {
+  epoll_event events[64];
+  while (!d->stop.load(std::memory_order_relaxed)) {
+    int n = epoll_wait(d->epfd, events, 64, 500);
+    for (int i = 0; i < n; i++) {
+      int fd = events[i].data.fd;
+      uint32_t evs = events[i].events;
+      if (fd == d->wake_r) {
+        char buf[64];
+        while (::read(d->wake_r, buf, sizeof buf) > 0) {}
+        continue;
+      }
+      if (fd == d->in_listen) { on_accept(d, fd, true); continue; }
+      if (fd == d->out_listen) { on_accept(d, fd, false); continue; }
+      auto it = d->conns.find(fd);
+      if (it == d->conns.end()) continue;
+      Conn* c = it->second;
+      if (evs & (EPOLLHUP | EPOLLERR)) { drop_conn(d, fd); continue; }
+      if (evs & EPOLLIN) {
+        on_readable(d, c);
+        if (d->conns.find(fd) == d->conns.end()) continue;
+      }
+      if (evs & EPOLLOUT) on_writable(d, c);
+    }
+    pump_all(d);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle, or nullptr. in_port/out_port receive the bound
+// ports. duplex=0: queue device (in "r" bound <- producers; out "w" bound
+// -> consumers, credit-gated). duplex=1: pipe relay, both sides rw.
+void* fiber_pump_create(int duplex, int* in_port, int* out_port) {
+  Device* d = new Device();
+  d->duplex = duplex != 0;
+  d->epfd = epoll_create1(0);
+  d->in_listen = make_listener(in_port);
+  d->out_listen = make_listener(out_port);
+  int pipefd[2];
+  if (d->epfd < 0 || d->in_listen < 0 || d->out_listen < 0 ||
+      pipe2(pipefd, O_NONBLOCK) < 0) {
+    if (d->epfd >= 0) ::close(d->epfd);
+    if (d->in_listen >= 0) ::close(d->in_listen);
+    if (d->out_listen >= 0) ::close(d->out_listen);
+    delete d;
+    return nullptr;
+  }
+  d->wake_r = pipefd[0];
+  d->wake_w = pipefd[1];
+  for (int fd : {d->in_listen, d->out_listen, d->wake_r}) {
+    epoll_event ev{};
+    ev.data.fd = fd;
+    ev.events = EPOLLIN;
+    epoll_ctl(d->epfd, EPOLL_CTL_ADD, fd, &ev);
+  }
+  d->thr = std::thread(run_loop, d);
+  return d;
+}
+
+// side: 0 = in (producers), 1 = out (consumers). Racy read, poll-friendly.
+int fiber_pump_peers(void* handle, int side) {
+  if (handle == nullptr) return 0;
+  Device* d = static_cast<Device*>(handle);
+  return side == 0 ? d->n_in.load() : d->n_out.load();
+}
+
+void fiber_pump_close(void* handle) {
+  if (handle == nullptr) return;
+  Device* d = static_cast<Device*>(handle);
+  d->stop.store(true);
+  ssize_t ignored = ::write(d->wake_w, "x", 1);
+  (void)ignored;
+  if (d->thr.joinable()) d->thr.join();
+  for (auto& kv : d->conns) {
+    ::close(kv.first);
+    delete kv.second;
+  }
+  ::close(d->in_listen);
+  ::close(d->out_listen);
+  ::close(d->wake_r);
+  ::close(d->wake_w);
+  ::close(d->epfd);
+  delete d;
+}
+
+}  // extern "C"

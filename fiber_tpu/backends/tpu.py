@@ -241,6 +241,20 @@ class TpuBackend(Backend):
         agent, pid = self._agent_for_job(job)
         agent.call("signal", pid, int(signal.SIGKILL))
 
+    def _resolved_hosts_spec(self) -> str:
+        return ",".join(f"{h}:{p}" for h, p in self._hosts)
+
+    def child_env(self) -> Dict[str, str]:
+        # Children must dial THIS cluster's agents — never re-expand a
+        # "sim:N" spec into a private cluster of their own.
+        return {
+            "FIBER_TPU_HOSTS": self._resolved_hosts_spec(),
+            "FIBER_BACKEND": "tpu",
+        }
+
+    def child_config(self) -> Dict[str, str]:
+        return {"tpu_hosts": self._resolved_hosts_spec(), "backend": "tpu"}
+
     def get_listen_addr(self) -> Tuple[str, int, str]:
         if all(h[0] in ("127.0.0.1", "localhost") for h in self._hosts):
             return ("127.0.0.1", 0, "lo")
@@ -257,6 +271,10 @@ class TpuBackend(Backend):
                     live.append(job)
             except Exception:
                 pass
+        # Prune finished jobs so the table (and this poll loop) stays
+        # bounded on long-lived masters.
+        with self._lock:
+            self._jobs = [j for j in self._jobs if j in live]
         return live
 
     # -- file staging (fiber cp parity) --------------------------------

@@ -73,7 +73,7 @@ def cmd_sim(args) -> int:
 def cmd_agent(args) -> int:
     from fiber_tpu import host_agent
 
-    argv = ["--port", str(args.port)]
+    argv = ["--port", str(args.port), "--bind", args.bind]
     if args.announce:
         argv.append("--announce")
     return host_agent.main(argv)
@@ -190,6 +190,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("agent", help="run the per-host agent daemon")
     p.add_argument("--port", type=int, default=7060)
+    p.add_argument("--bind", default="0.0.0.0",
+                   help="interface to bind (use 127.0.0.1 for local-only)")
     p.add_argument("--announce", action="store_true")
     p.set_defaults(fn=cmd_agent)
 

@@ -113,3 +113,14 @@ class Backend:
     def list_jobs(self) -> List[Job]:  # pragma: no cover - optional
         """Live jobs created by this backend (leak-check fixture support)."""
         return []
+
+    def child_env(self) -> Dict[str, str]:
+        """Extra environment for spawned jobs (e.g. resolved cluster
+        addresses so children dial the parent's cluster instead of
+        re-deriving their own)."""
+        return {}
+
+    def child_config(self) -> Dict[str, Any]:
+        """Config-key overrides shipped to children in the preparation
+        data, merged over the parent's resolved config."""
+        return {}

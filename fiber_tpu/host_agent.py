@@ -120,13 +120,23 @@ class HostAgent:
         )
         full_env = dict(os.environ)
         full_env.update(env or {})
-        proc = subprocess.Popen(
-            list(command),
-            cwd=cwd if cwd and os.path.isdir(cwd) else None,
-            env=full_env,
-            stdout=log_fd,
-            stderr=subprocess.STDOUT,
-        )
+        try:
+            proc = subprocess.Popen(
+                list(command),
+                cwd=cwd if cwd and os.path.isdir(cwd) else None,
+                env=full_env,
+                stdout=log_fd,
+                stderr=subprocess.STDOUT,
+            )
+        except BaseException:
+            # Bad command must not leak the fd/logfile on a long-lived
+            # agent (a master retry loop would exhaust descriptors).
+            os.close(log_fd)
+            try:
+                os.unlink(log_path)
+            except OSError:
+                pass
+            raise
         os.close(log_fd)
         with self._lock:
             self._next_jid += 1

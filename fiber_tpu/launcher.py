@@ -141,6 +141,8 @@ class JobLauncher:
             pythonpath = (
                 pkg_root + os.pathsep + pythonpath if pythonpath else pkg_root
             )
+        env = {"FIBER_WORKER": "1", "PYTHONPATH": pythonpath}
+        env.update(self.backend.child_env())
         return JobSpec(
             command=cmd,
             image=cfg.image or None,
@@ -149,7 +151,7 @@ class JobLauncher:
             mem=mem,
             gpu=hints.get("gpu"),
             tpu=hints.get("tpu"),
-            env={"FIBER_WORKER": "1", "PYTHONPATH": pythonpath},
+            env=env,
             cwd=os.getcwd(),
             host_hint=getattr(process_obj, "_host_hint", None),
         )
@@ -157,8 +159,10 @@ class JobLauncher:
     def _preparation_data(self, process_obj) -> Dict[str, Any]:
         """Config + main-module info the worker needs before unpickling the
         Process (so targets defined in the user's __main__ resolve)."""
+        child_cfg = config.get().as_dict()
+        child_cfg.update(self.backend.child_config())
         prep: Dict[str, Any] = {
-            "fiber_config": config.get().as_dict(),
+            "fiber_config": child_cfg,
             "name": process_obj.name,
             "sys_path": list(sys.path),
             "sys_argv": list(sys.argv),

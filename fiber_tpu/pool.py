@@ -442,6 +442,8 @@ class Pool:
 
         self._workers: List = []
         self._workers_lock = threading.Lock()
+        self._spawning_slots = 0   # sub-worker slots with spawns in flight
+        self._reaped = False       # join() finished reaping; no late adds
         self._closed = False
         self._terminated = False
         self._workers_started = False
@@ -525,9 +527,13 @@ class Pool:
             for p in dead:
                 self._workers.remove(p)
                 self._on_worker_death(p)
-            # Sub-worker slots still covered by live jobs; jobs pack
-            # cpu_per_job sub-workers each, the last one the remainder.
-            covered = sum(getattr(p, "_n_local", 1) for p in self._workers)
+            # Sub-worker slots still covered by live jobs (plus spawns in
+            # flight); jobs pack cpu_per_job sub-workers each, the last
+            # one the remainder.
+            covered = (
+                sum(getattr(p, "_n_local", 1) for p in self._workers)
+                + self._spawning_slots
+            )
         missing_subs = self._n_workers - covered
         if missing_subs <= 0:
             return
@@ -546,14 +552,25 @@ class Pool:
         # worker, so a spawn outliving the pacing join below can never
         # leave an untracked live process, and a terminate() that raced
         # the spawn reaps it immediately.
+        with self._workers_lock:
+            self._spawning_slots += sum(plan)
+
         def spawn_one(n_local: int) -> None:
-            p = self._spawn_worker(n_local)
+            try:
+                p = self._spawn_worker(n_local)
+            except BaseException:
+                p = None
+            finally:
+                with self._workers_lock:
+                    self._spawning_slots -= n_local
             if p is None:
                 return
             with self._workers_lock:
-                if not self._terminated:
+                if not self._terminated and not self._reaped:
                     self._workers.append(p)
                     return
+            # Stragglers that finished after terminate()/join() reaped the
+            # pool are shut down immediately, never left untracked.
             p.terminate()
 
         threads = [
@@ -777,6 +794,7 @@ class Pool:
         if not self._terminated and not self._resilient:
             self._release_workers()
         with self._workers_lock:
+            self._reaped = True  # late spawn stragglers self-terminate
             workers = list(self._workers)
         for p in workers:
             p.join(10)

@@ -188,7 +188,7 @@ class SimpleQueue:
         creating process; used to make round-robin fan-out exact)."""
         if self._device is None:
             raise ValueError("wait_consumers: not the creating process")
-        return self._device.out_ep.wait_for_peers(n, timeout)
+        return self._device.wait_out_peers(n, timeout)
 
     def close(self) -> None:
         if self._writer is not None:

@@ -421,12 +421,29 @@ class Device:
     """
 
     def __init__(self, in_mode: str, out_mode: str, ip: str) -> None:
+        self._native = None
+        duplex = in_mode == "rw" and out_mode == "rw"
+        if (in_mode, out_mode) in (("r", "w"), ("rw", "rw")):
+            try:
+                from fiber_tpu._native import NativePump, available
+
+                if available():
+                    self._native = NativePump(duplex)
+            except Exception:
+                self._native = None
+        if self._native is not None:
+            self.in_ep = None
+            self.out_ep = None
+            self.in_addr = f"tcp://{ip}:{self._native.in_port}"
+            self.out_addr = f"tcp://{ip}:{self._native.out_port}"
+            self._pumps: List[threading.Thread] = []
+            return
         self.in_ep = Endpoint(in_mode)
         self.out_ep = Endpoint(out_mode)
         self.in_addr = self.in_ep.bind(ip)
         self.out_addr = self.out_ep.bind(ip)
-        self._pumps: List[threading.Thread] = []
-        if in_mode == "rw" and out_mode == "rw":
+        self._pumps = []
+        if duplex:
             self._start_pump(self.in_ep, self.out_ep)
             self._start_pump(self.out_ep, self.in_ep)
         else:
@@ -457,6 +474,26 @@ class Device:
                 except (TransportClosed, OSError):
                     return
 
+    def wait_out_peers(self, n: int, timeout: Optional[float] = None) -> bool:
+        """Block until n consumers are connected (both pump impls)."""
+        if self._native is not None:
+            import time as _time
+
+            deadline = None if timeout is None else _time.monotonic() + timeout
+            while self._native.peers("out") < n:
+                if deadline is not None and _time.monotonic() > deadline:
+                    return False
+                _time.sleep(0.01)
+            return True
+        return self.out_ep.wait_for_peers(n, timeout)
+
+    @property
+    def is_native(self) -> bool:
+        return self._native is not None
+
     def close(self) -> None:
+        if self._native is not None:
+            self._native.close()
+            return
         self.in_ep.close()
         self.out_ep.close()

@@ -1,0 +1,60 @@
+"""Large-batch ES with a ConvNet policy on pixel observations — the
+reference's "Atari ES" configuration shape (BASELINE.json), with a
+procedural pixel env so the entire rollout (render → conv policy → move)
+compiles into one XLA program. Convs are the MXU path: the policy forward
+is where the FLOPs are.
+
+Run:  python examples/es_conv_pixels.py [--pop 256] [--gens 20]
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+
+import argparse
+import sys
+import time
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--pop", type=int, default=256)
+    parser.add_argument("--gens", type=int, default=20)
+    parser.add_argument("--steps", type=int, default=40)
+    args = parser.parse_args()
+
+    import jax
+
+    from fiber_tpu.models import ConvPolicy
+    from fiber_tpu.models.envs import PixelChase
+    from fiber_tpu.ops import EvolutionStrategy
+
+    policy = ConvPolicy(PixelChase.obs_shape, PixelChase.act_dim,
+                        channels=(8, 16), hidden=64)
+    print(f"conv policy params: {policy.dim:,}")
+
+    def eval_fn(theta, key):
+        return PixelChase.rollout(policy.act, theta, key,
+                                  max_steps=args.steps)
+
+    es = EvolutionStrategy(eval_fn, dim=policy.dim, pop_size=args.pop,
+                           sigma=0.05, lr=0.02)
+    params = policy.init(jax.random.PRNGKey(0))
+
+    t0 = time.time()
+    params, history = es.run(params, jax.random.PRNGKey(1),
+                             generations=args.gens,
+                             log_every=max(1, args.gens // 5))
+    elapsed = time.time() - t0
+    for gen, mean, best in history:
+        print(f"gen {gen:4d}  mean {mean:8.3f}  best {best:8.3f}")
+    evals = es.pop_size * args.gens
+    print(f"{evals} conv-policy evals in {elapsed:.1f}s "
+          f"= {evals / elapsed:,.0f} evals/s")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,9 @@ constexpr uint64_t kCreditWindow = 4096;  // matches transport/tcp.py
 constexpr uint8_t kData = 0x00;
 constexpr uint8_t kCredit = 0x01;
 constexpr size_t kReadChunk = 1 << 16;
+// Frame ceiling (matches framing.py MAX_FRAME): bounds `8 + flen`
+// arithmetic and rejects corrupted/hostile length headers.
+constexpr uint64_t kMaxFrame = 1ULL << 40;
 
 uint64_t be64(const uint8_t* p) {
   uint64_t v = 0;
@@ -61,6 +64,7 @@ std::vector<uint8_t> credit_frame(uint32_t n) {
 
 struct Conn {
   int fd = -1;
+  uint64_t id = 0;               // generation id: never reused, unlike fds
   bool in_side = false;          // accepted on the in-listener
   // read state machine
   std::vector<uint8_t> rbuf;
@@ -74,7 +78,7 @@ struct Conn {
 
 struct PendingFrame {
   std::vector<uint8_t> wire;     // full frame incl. header+type
-  int source_fd;                 // for credit replenish (-1 = none)
+  uint64_t source_id;            // for credit replenish (0 = none)
 };
 
 struct Device {
@@ -83,6 +87,8 @@ struct Device {
   int wake_r = -1, wake_w = -1;
   bool duplex = false;
   std::unordered_map<int, Conn*> conns;
+  std::unordered_map<uint64_t, Conn*> conns_by_id;
+  uint64_t next_conn_id = 1;
   std::vector<int> in_fds, out_fds;
   std::deque<PendingFrame> fifo_fwd;   // in -> out
   std::deque<PendingFrame> fifo_rev;   // out -> in (duplex only)
@@ -149,8 +155,10 @@ void pump_fifo(Device* d, std::deque<PendingFrame>& fifo,
     if (use_credit) {
       chosen->credit--;
       // replenish the producer's standing window as its frame departs
-      auto sit = d->conns.find(pf.source_fd);
-      if (sit != d->conns.end() && !sit->second->dead) {
+      // (lookup by generation id: a reused fd must not receive credit
+      // meant for a connection that no longer exists)
+      auto sit = d->conns_by_id.find(pf.source_id);
+      if (sit != d->conns_by_id.end() && !sit->second->dead) {
         queue_write(d, sit->second, credit_frame(1));
       }
     }
@@ -174,7 +182,7 @@ void handle_frame(Device* d, Conn* c, const uint8_t* body, uint64_t blen,
   }
   PendingFrame pf;
   pf.wire.assign(wire, wire + wlen);
-  pf.source_fd = c->fd;
+  pf.source_id = c->id;
   if (c->in_side) {
     d->fifo_fwd.push_back(std::move(pf));
   } else if (d->duplex) {
@@ -207,6 +215,10 @@ void on_readable(Device* d, Conn* c) {
   for (;;) {
     if (c->rbuf.size() - pos < 8) break;
     uint64_t flen = be64(c->rbuf.data() + pos);
+    if (flen > kMaxFrame) {  // corrupt/hostile header: kill the stream
+      drop_conn(d, c->fd);
+      return;
+    }
     if (c->rbuf.size() - pos < 8 + flen) break;
     handle_frame(d, c, c->rbuf.data() + pos + 8, flen,
                  c->rbuf.data() + pos, 8 + flen);
@@ -248,6 +260,7 @@ void drop_conn(Device* d, int fd) {
   epoll_ctl(d->epfd, EPOLL_CTL_DEL, fd, nullptr);
   ::close(fd);
   d->conns.erase(it);
+  d->conns_by_id.erase(c->id);
   auto scrub = [fd](std::vector<int>& v) {
     for (size_t i = 0; i < v.size(); i++) {
       if (v[i] == fd) { v.erase(v.begin() + i); break; }
@@ -267,8 +280,10 @@ void on_accept(Device* d, int listen_fd, bool in_side) {
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
     Conn* c = new Conn();
     c->fd = fd;
+    c->id = d->next_conn_id++;
     c->in_side = in_side;
     d->conns[fd] = c;
+    d->conns_by_id[c->id] = c;
     (in_side ? d->in_fds : d->out_fds).push_back(fd);
     (in_side ? d->n_in : d->n_out).fetch_add(1);
     epoll_event ev{};

@@ -265,16 +265,19 @@ class TpuBackend(Backend):
         with self._lock:
             jobs = list(self._jobs)
         live = []
+        finished = set()
         for job in jobs:
             try:
                 if self.get_job_status(job) == ProcessStatus.STARTED:
                     live.append(job)
+                else:
+                    finished.add(id(job))
             except Exception:
-                pass
-        # Prune finished jobs so the table (and this poll loop) stays
-        # bounded on long-lived masters.
+                pass  # transient RPC failure: keep tracking the job
+        # Prune only jobs *observed finished* — jobs created concurrently
+        # with the polling above (or whose poll failed) stay tracked.
         with self._lock:
-            self._jobs = [j for j in self._jobs if j in live]
+            self._jobs = [j for j in self._jobs if id(j) not in finished]
         return live
 
     # -- file staging (fiber cp parity) --------------------------------

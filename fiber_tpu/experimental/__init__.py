@@ -1,0 +1,11 @@
+"""API-parity shim: the reference exposes Ring under
+``fiber.experimental`` (fiber/experimental/__init__.py); fiber_tpu's Ring
+lives in ``fiber_tpu.parallel`` but remains importable from here so
+reference users find it where they expect."""
+
+from fiber_tpu.parallel.ring import Ring, RingNode  # noqa: F401
+from fiber_tpu.parallel.ring import (  # noqa: F401
+    current_ring,
+    default_initializer,
+    jax_distributed_initializer,
+)

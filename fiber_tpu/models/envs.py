@@ -93,6 +93,91 @@ class CartPole:
         return total
 
 
+class ParamCartPole(CartPole):
+    """CartPole with mutable physics — the substrate for POET-style
+    env/agent co-evolution (the reference's POET example evolves
+    BipedalWalker terrains; here the evolvable environment parameters are
+    the physics vector [gravity, pole_half_length, force_mag, masspole],
+    harder configs = heavier/longer pole, weaker cart).
+
+    ``env_params`` rides through rollouts as a jax array so a whole
+    population of (env, agent) pairs can evaluate in one SPMD program.
+    """
+
+    #: default physics vector (matches CartPole-v1)
+    DEFAULT = (9.8, 0.5, 10.0, 0.1)
+    PARAM_LOW = (4.0, 0.25, 4.0, 0.05)
+    PARAM_HIGH = (19.0, 1.5, 14.0, 0.6)
+
+    @classmethod
+    def step_p(cls, env_params, state, action):
+        import jax.numpy as jnp
+
+        gravity, length, force_mag, masspole = (
+            env_params[0], env_params[1], env_params[2], env_params[3]
+        )
+        x, x_dot, theta, theta_dot = state
+        force = jnp.where(action == 1, force_mag, -force_mag)
+        costheta = jnp.cos(theta)
+        sintheta = jnp.sin(theta)
+        total_mass = cls.masscart + masspole
+        polemass_length = masspole * length
+
+        temp = (force + polemass_length * theta_dot**2 * sintheta) / total_mass
+        thetaacc = (gravity * sintheta - costheta * temp) / (
+            length * (4.0 / 3.0 - masspole * costheta**2 / total_mass)
+        )
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+
+        x = x + cls.tau * x_dot
+        x_dot = x_dot + cls.tau * xacc
+        theta = theta + cls.tau * theta_dot
+        theta_dot = theta_dot + cls.tau * thetaacc
+        new_state = jnp.stack([x, x_dot, theta, theta_dot])
+        terminated = (
+            (jnp.abs(x) > cls.x_threshold)
+            | (jnp.abs(theta) > cls.theta_threshold)
+        )
+        return new_state, terminated
+
+    @classmethod
+    def rollout_p(cls, act_fn, env_params, flat_params, key,
+                  max_steps: int | None = None):
+        """Episode reward under a specific physics vector; jittable and
+        vmappable over (env_params, flat_params) pairs."""
+        import jax
+        import jax.numpy as jnp
+
+        steps = max_steps or cls.max_steps
+        state0 = cls.reset(key)
+
+        def scan_step(carry, _):
+            state, done, total = carry
+            action = act_fn(flat_params, state)
+            next_state, terminated = cls.step_p(env_params, state, action)
+            reward = jnp.where(done, 0.0, 1.0)
+            new_done = done | terminated
+            new_state = jnp.where(done, state, next_state)
+            return (new_state, new_done, total + reward), None
+
+        (_, _, total), _ = jax.lax.scan(
+            scan_step, (state0, jnp.asarray(False), jnp.asarray(0.0)),
+            None, length=steps,
+        )
+        return total
+
+    @classmethod
+    def mutate(cls, env_params, key, scale: float = 0.15):
+        """Perturb the physics vector within bounds (POET env mutation)."""
+        import jax
+        import jax.numpy as jnp
+
+        low = jnp.asarray(cls.PARAM_LOW)
+        high = jnp.asarray(cls.PARAM_HIGH)
+        noise = jax.random.normal(key, (4,)) * scale * (high - low)
+        return jnp.clip(jnp.asarray(env_params) + noise, low, high)
+
+
 class Pendulum:
     obs_dim = 3
     act_dim = 1
@@ -158,6 +243,71 @@ class Pendulum:
 
         (_, total), _ = jax.lax.scan(
             scan_step, (state0, jnp.asarray(0.0)), None, length=steps
+        )
+        return total
+
+
+class PixelChase:
+    """Procedural pixel-observation env for ConvNet-policy ES (stands in
+    for the reference's Atari large-batch ES config — no ROMs needed, and
+    the whole env renders/steps inside XLA).
+
+    The agent (one blob) chases a target (another blob) on an H×W grid;
+    observations are rendered single-channel images; actions are the four
+    moves + stay; reward is negative distance (closing in scores higher).
+    """
+
+    H = 24
+    W = 24
+    obs_shape = (24, 24, 1)
+    act_dim = 5
+    max_steps = 60
+
+    _MOVES = ((0, 0), (0, 1), (0, -1), (1, 0), (-1, 0))
+
+    @classmethod
+    def _render(cls, agent_yx, target_yx):
+        import jax.numpy as jnp
+
+        ys = jnp.arange(cls.H)[:, None]
+        xs = jnp.arange(cls.W)[None, :]
+        agent_img = jnp.exp(
+            -((ys - agent_yx[0]) ** 2 + (xs - agent_yx[1]) ** 2) / 4.0
+        )
+        target_img = -jnp.exp(
+            -((ys - target_yx[0]) ** 2 + (xs - target_yx[1]) ** 2) / 4.0
+        )
+        return (agent_img + target_img)[..., None]
+
+    @classmethod
+    def rollout(cls, act_fn, flat_params, key,
+                max_steps: int | None = None):
+        import jax
+        import jax.numpy as jnp
+
+        steps = max_steps or cls.max_steps
+        k1, k2 = jax.random.split(key)
+        agent0 = jax.random.uniform(
+            k1, (2,), minval=2.0, maxval=cls.H - 3.0
+        )
+        target = jax.random.uniform(
+            k2, (2,), minval=2.0, maxval=cls.H - 3.0
+        )
+        moves = jnp.asarray(cls._MOVES, dtype=jnp.float32)
+
+        def scan_step(carry, _):
+            agent, total = carry
+            obs = cls._render(agent, target)
+            action = act_fn(flat_params, obs)
+            agent = jnp.clip(
+                agent + moves[action], 0.0, float(cls.H - 1)
+            )
+            dist = jnp.sqrt(jnp.sum((agent - target) ** 2))
+            reward = -dist / cls.H
+            return (agent, total + reward), None
+
+        (_, total), _ = jax.lax.scan(
+            scan_step, (agent0, jnp.asarray(0.0)), None, length=steps
         )
         return total
 

@@ -662,14 +662,17 @@ class Pool:
         if chunksize is None:
             chunksize = max(1, min(DEFAULT_CHUNKSIZE,
                                    len(items) // (self._n_workers * 4) or 1))
-        blob = serialization.dumps(func)
-        digest = hashlib.md5(blob).digest()
-        for base in range(0, len(items), chunksize):
-            chunk = items[base:base + chunksize]
-            payload = serialization.dumps(
-                ("task", seq, base, digest, blob, chunk, star)
-            )
-            self._taskq.put((payload, (seq, base)))
+        from fiber_tpu.utils.profiling import global_timer
+
+        with global_timer.section("pool.serialize"):
+            blob = serialization.dumps(func)
+            digest = hashlib.md5(blob).digest()
+            for base in range(0, len(items), chunksize):
+                chunk = items[base:base + chunksize]
+                payload = serialization.dumps(
+                    ("task", seq, base, digest, blob, chunk, star)
+                )
+                self._taskq.put((payload, (seq, base)))
         return result
 
     # -- public API --------------------------------------------------------

@@ -1,0 +1,95 @@
+"""Tracing / profiling hooks.
+
+The reference has no instrumentation beyond debug logs (SURVEY.md §5:
+"Tracing/profiling: none ... add JAX profiler hooks as the idiomatic
+equivalent — this is a gap, not a port target"). fiber_tpu provides:
+
+* ``trace(path)`` — context manager wrapping ``jax.profiler.trace`` so a
+  device-plane region (ES generations, device_map calls) produces a
+  TensorBoard-loadable XLA trace;
+* ``annotate(name)`` — ``jax.profiler.TraceAnnotation`` passthrough for
+  labelling host-side regions inside a trace;
+* ``Timer`` / ``timed`` — lightweight host-plane timing with aggregated
+  stats, used by the pool to expose per-phase timings
+  (``pool.stats()``-style introspection without a profiler UI).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture an XLA/host trace of the enclosed region into ``log_dir``
+    (view with TensorBoard's profile plugin)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Label a region inside an active trace."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+class Timer:
+    """Aggregating wall-clock timer: ``with timer.section("pickle"): ...``;
+    ``timer.stats()`` returns {section: (count, total_s, mean_s)}."""
+
+    def __init__(self) -> None:
+        self._totals: Dict[str, float] = defaultdict(float)
+        self._counts: Dict[str, int] = defaultdict(int)
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def section(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - t0
+            with self._lock:
+                self._totals[name] += elapsed
+                self._counts[name] += 1
+
+    def add(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._totals[name] += seconds
+            self._counts[name] += 1
+
+    def stats(self) -> Dict[str, tuple]:
+        with self._lock:
+            return {
+                name: (
+                    self._counts[name],
+                    round(total, 6),
+                    round(total / self._counts[name], 6),
+                )
+                for name, total in self._totals.items()
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._totals.clear()
+            self._counts.clear()
+
+
+#: Process-wide timer the pool and transport report into.
+global_timer = Timer()
+
+
+@contextlib.contextmanager
+def timed(name: str, timer: Optional[Timer] = None) -> Iterator[None]:
+    with (timer or global_timer).section(name):
+        yield

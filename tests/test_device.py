@@ -161,3 +161,35 @@ def test_es_improves_cartpole():
     final_mean = history[-1][1]
     assert history, "no history logged"
     assert final_mean > initial_mean, (initial_mean, final_mean)
+
+
+def test_param_cartpole_and_poet_smoke():
+    """POET co-evolution runs and improves (compact check)."""
+    import jax
+
+    from fiber_tpu.models.envs import ParamCartPole
+    from fiber_tpu.ops.poet import POET
+
+    policy = MLPPolicy(ParamCartPole.obs_dim, ParamCartPole.act_dim,
+                       hidden=(8,))
+    poet = POET(ParamCartPole, policy, pop_size=32, max_pairs=3,
+                rollout_steps=80, mc_low=5.0)
+    history = poet.run(jax.random.PRNGKey(0), iterations=2, es_steps=2)
+    assert len(history) == 2
+    assert history[-1]["pairs"] >= 1
+    assert np.isfinite(history[-1]["mean_fitness"])
+
+
+def test_conv_policy_pixel_rollout():
+    import jax
+
+    from fiber_tpu.models import ConvPolicy
+    from fiber_tpu.models.envs import PixelChase
+
+    policy = ConvPolicy(PixelChase.obs_shape, PixelChase.act_dim,
+                        channels=(4,), hidden=16)
+    params = policy.init(jax.random.PRNGKey(0))
+    reward = jax.jit(
+        lambda p, k: PixelChase.rollout(policy.act, p, k, max_steps=10)
+    )(params, jax.random.PRNGKey(1))
+    assert np.isfinite(float(jax.device_get(reward)))

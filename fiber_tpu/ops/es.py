@@ -51,6 +51,7 @@ class EvolutionStrategy:
         lr: float = 0.02,
         mesh=None,
         weight_decay: float = 0.0,
+        use_pallas: str | bool = "auto",
     ) -> None:
         import numpy as np
 
@@ -67,6 +68,15 @@ class EvolutionStrategy:
         quantum = 2 * self.n_dev
         self.pop_size = max(quantum, (pop_size // quantum) * quantum)
         self.pairs_per_dev = self.pop_size // quantum
+        # Pallas fused-noise path: regenerate eps instead of storing it
+        # (fiber_tpu/ops/pallas_es.py). "auto" engages it only on TPU and
+        # only after a runtime noise-quality self-check.
+        if use_pallas == "auto":
+            from fiber_tpu.ops.pallas_es import pallas_available
+
+            self.use_pallas = pallas_available()
+        else:
+            self.use_pallas = bool(use_pallas)
         self._step = self._build_step()
 
     # ------------------------------------------------------------------
@@ -84,16 +94,36 @@ class EvolutionStrategy:
         pop = self.pop_size
         dim = self.dim
 
+        use_pallas = self.use_pallas
+        if use_pallas:
+            from fiber_tpu.ops.pallas_es import (
+                build_perturb,
+                build_weighted_eps_sum,
+            )
+
+            perturb_fn = build_perturb(pairs, dim, sigma)
+            wsum_fn = build_weighted_eps_sum(pairs, dim)
+
         def device_step(params, key):
             # params (dim,) replicated; key replicated
             my = jax.lax.axis_index("pool")
             dev_key = jax.random.fold_in(key, my)
             eps_key, eval_key = jax.random.split(dev_key)
-            eps = jax.random.normal(eps_key, (pairs, dim))
 
-            thetas = jnp.concatenate(
-                [params + sigma * eps, params - sigma * eps], axis=0
-            )  # (2*pairs, dim)
+            if use_pallas:
+                # Fused on-chip noise: eps never materializes in HBM; the
+                # gradient pass regenerates it from the same seed (two
+                # 31-bit words — one word birthday-collides across big
+                # meshes and long runs).
+                seed = jax.random.randint(
+                    eps_key, (2,), 0, 2**31 - 1, dtype=jnp.int32
+                )
+                thetas = perturb_fn(params, seed)       # (2*pairs, dim)
+            else:
+                eps = jax.random.normal(eps_key, (pairs, dim))
+                thetas = jnp.concatenate(
+                    [params + sigma * eps, params - sigma * eps], axis=0
+                )  # (2*pairs, dim)
             eval_keys = jax.random.split(eval_key, 2 * pairs)
             fitness = jax.vmap(eval_fn)(thetas, eval_keys)  # (2*pairs,)
 
@@ -105,7 +135,10 @@ class EvolutionStrategy:
             my_ranks = ranks[my]                       # (2*pairs,)
             w = my_ranks[:pairs] - my_ranks[pairs:]    # antithetic weights
 
-            g_local = w @ eps                          # (dim,) on the MXU
+            if use_pallas:
+                g_local = wsum_fn(w, seed)             # regenerated eps
+            else:
+                g_local = w @ eps                      # (dim,) on the MXU
             grad = jax.lax.psum(g_local, "pool") / (pop * sigma)
             new_params = params + lr * grad - lr * wd * params
             stats = jnp.stack([

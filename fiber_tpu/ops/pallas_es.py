@@ -221,17 +221,24 @@ def pallas_available() -> bool:
         if jax.devices()[0].platform != "tpu":
             _SELF_CHECK = False
             return False
+        seed = jnp.asarray([12345, 678], jnp.int32)
         pert = build_perturb(PAIR_BLOCK, DIM_BLOCK, 1.0)
-        thetas = pert(jnp.zeros((DIM_BLOCK,), jnp.float32),
-                      jnp.asarray([12345, 678], jnp.int32))
+        thetas = pert(jnp.zeros((DIM_BLOCK,), jnp.float32), seed)
         eps = jax.device_get(thetas[:PAIR_BLOCK])
-        ok = (
+        noise_ok = (
             abs(float(eps.mean())) < 0.2
             and 0.8 < float(eps.std()) < 1.2
             and bool(jnp.allclose(thetas[:PAIR_BLOCK],
                                   -thetas[PAIR_BLOCK:], atol=1e-5))
         )
-        _SELF_CHECK = ok
+        # The gradient kernel must regenerate the SAME noise the perturb
+        # pass evaluated, or ES gradients are silently wrong: check
+        # w @ eps against the perturb output.
+        w = jnp.linspace(-1.0, 1.0, PAIR_BLOCK)
+        g = build_weighted_eps_sum(PAIR_BLOCK, DIM_BLOCK)(w, seed)
+        g_ref = w @ thetas[:PAIR_BLOCK]
+        grad_ok = bool(jnp.allclose(g, g_ref, atol=1e-3 * DIM_BLOCK**0.5))
+        _SELF_CHECK = noise_ok and grad_ok
     except Exception:
         _SELF_CHECK = False
     if not _SELF_CHECK:

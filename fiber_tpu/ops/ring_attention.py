@@ -1,0 +1,151 @@
+"""Ring attention: exact attention over sequences sharded across the mesh.
+
+The reference framework has no sequence parallelism of any kind (SURVEY.md
+§5 — it predates it and is not a model trainer). fiber_tpu provides it as
+a first-class device-plane op so long-context workloads scale the same way
+the rest of the framework does: shard the sequence over the ``pool`` axis
+and let the KV blocks ride ICI.
+
+Algorithm (Ring Attention / blockwise online softmax): each device owns a
+query block and its local KV block; KV blocks rotate around the ring via
+``lax.ppermute`` while every device maintains an online-softmax
+accumulator (running max ``m``, denominator ``l``, numerator ``o``) — so
+the full (S, S) score matrix never materializes anywhere and peak memory
+per device is O(S_local · S_local) instead of O(S²). After ``n_devices``
+rotations the result equals exact softmax attention.
+
+Causal masking uses global positions derived from ``axis_index``, so the
+mask stays correct as blocks rotate.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def _block_attn(q, k, v, mask):
+    """Scores for one (query-block, kv-block) pair.
+
+    q: (sq, h, d)   k, v: (skv, h, d)   mask: (sq, skv) or None
+    returns s: (h, sq, skv)
+    """
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    s = jnp.einsum("qhd,khd->hqk", q, k) / jnp.sqrt(jnp.asarray(d, q.dtype))
+    if mask is not None:
+        s = jnp.where(mask[None, :, :], s, jnp.finfo(s.dtype).min)
+    return s
+
+
+_compiled_cache: dict = {}
+
+
+def _build_ring_attention(mesh, axis: str, causal: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n_dev = mesh.shape[axis]
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def local(q_blk, k_blk, v_blk):
+        sq = q_blk.shape[0]
+        h = q_blk.shape[1]
+        my = jax.lax.axis_index(axis)
+        q_pos = my * sq + jnp.arange(sq)            # global query positions
+
+        def accumulate(k_cur, v_cur, src_dev, m, l, o):
+            kv_pos = src_dev * sq + jnp.arange(sq)  # global kv positions
+            mask = None
+            if causal:
+                mask = q_pos[:, None] >= kv_pos[None, :]
+            s = _block_attn(q_blk, k_cur, v_cur, mask)   # (h, sq, skv)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            # Guard -inf - -inf (fully masked rows) producing NaN.
+            m_safe = jnp.where(jnp.isinf(m_new), 0.0, m_new)
+            p = jnp.exp(s - m_safe[..., None])
+            if mask is not None:
+                p = jnp.where(mask[None, :, :], p, 0.0)
+            corr = jnp.where(
+                jnp.isinf(m), 0.0, jnp.exp(m - m_safe)
+            )                                            # (h, sq)
+            l_new = l * corr + p.sum(axis=-1)
+            o_corr = o * corr.transpose(1, 0)[:, :, None]
+            o_new = o_corr + jnp.einsum("hqk,khd->qhd", p, v_cur)
+            return m_new, l_new, o_new
+
+        m0 = jnp.full((h, sq), -jnp.inf, q_blk.dtype)
+        l0 = jnp.zeros((h, sq), q_blk.dtype)
+        o0 = jnp.zeros_like(q_blk)                  # (sq, h, d)
+
+        def body(carry, step):
+            # rotate first, then accumulate: the scan covers rotations
+            # 1..n_dev-1, the local block is accumulated outside — so no
+            # final wasted KV rotation ships around the ring.
+            k_cur, v_cur, src_dev, m, l, o = carry
+            k_cur = jax.lax.ppermute(k_cur, axis, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis, perm)
+            src_dev = (src_dev - 1) % n_dev
+            m, l, o = accumulate(k_cur, v_cur, src_dev, m, l, o)
+            return (k_cur, v_cur, src_dev, m, l, o), None
+
+        m, l, o = accumulate(k_blk, v_blk, my, m0, l0, o0)
+        if n_dev > 1:
+            (_, _, _, m, l, o), _ = jax.lax.scan(
+                body, (k_blk, v_blk, my, m, l, o),
+                jnp.arange(n_dev - 1),
+            )
+        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros
+        return o / l.transpose(1, 0)[:, :, None]
+
+    spec = P(axis)
+    return jax.jit(shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    ))
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    mesh=None,
+    axis: str = "pool",
+    causal: bool = False,
+):
+    """Exact attention with sequence sharded over the mesh.
+
+    q, k, v: (seq, heads, head_dim) — ``seq`` must divide evenly over the
+    axis. Returns (seq, heads, head_dim) with the same sharding. The
+    compiled program is cached per (mesh, axis, causal); shapes re-use
+    jit's own cache.
+    """
+    from fiber_tpu.parallel.mesh import default_mesh
+
+    mesh = mesh or default_mesh()
+    key = (id(mesh), axis, causal)
+    fn = _compiled_cache.get(key)
+    if fn is None:
+        fn = _build_ring_attention(mesh, axis, causal)
+        _compiled_cache[key] = fn
+    return fn(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """Naive exact attention for testing (full score matrix)."""
+    import jax
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    s = jnp.einsum("qhd,khd->hqk", q, k) / jnp.sqrt(jnp.asarray(d, q.dtype))
+    if causal:
+        sq = q.shape[0]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sq)[None, :]
+        s = jnp.where(mask[None], s, jnp.finfo(s.dtype).min)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("hqk,khd->qhd", p, v)

@@ -1,0 +1,115 @@
+"""Checkpoint/restore for device-plane state (ES/POET populations).
+
+The reference has no built-in checkpointing — durable state is delegated
+to cluster volumes (SURVEY.md §5: PVCs + ``fiber cp``; posture "use
+GCS"). fiber_tpu keeps that posture for the host plane (stage files with
+``fiber-tpu cp``) and adds a small arrays-first checkpointer for
+device-plane state, because ES/POET runs are long and their state is just
+a pytree of arrays.
+
+Format: a single ``.npz`` holding the flattened leaves plus a JSON
+structure skeleton — no pickle anywhere (safe to load untrusted files,
+stable across library upgrades), loadable with plain numpy. Supported
+containers: dict / list / tuple; leaves: arrays and scalars.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+_LEAF = "__leaf__:"
+
+
+def _encode(obj: Any, leaves: list) -> Any:
+    """Structure skeleton as plain JSON; arrays/scalars become leaf
+    placeholders. Only dict/list/tuple containers are supported — no
+    pickle anywhere, so untrusted checkpoints can't execute code and jax
+    upgrades can't break old files."""
+    import numpy as np
+
+    if isinstance(obj, dict):
+        return {str(k): _encode(v, leaves) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        kind = "list" if isinstance(obj, list) else "tuple"
+        return {"__seq__": kind,
+                "items": [_encode(v, leaves) for v in obj]}
+    if obj is None:
+        return None
+    # everything else must be array-like
+    leaves.append(np.asarray(obj))
+    return _LEAF + str(len(leaves) - 1)
+
+
+def _decode(node: Any, leaves: list) -> Any:
+    if isinstance(node, dict):
+        if "__seq__" in node:
+            items = [_decode(v, leaves) for v in node["items"]]
+            return tuple(items) if node["__seq__"] == "tuple" else items
+        return {k: _decode(v, leaves) for k, v in node.items()}
+    if isinstance(node, str) and node.startswith(_LEAF):
+        return leaves[int(node[len(_LEAF):])]
+    if node is None:
+        return None
+    raise ValueError(f"corrupt checkpoint structure node: {node!r}")
+
+
+def save(path: str, tree: Any) -> None:
+    """Atomically write a pytree (dict/list/tuple of arrays) to ``path``
+    (.npz)."""
+    import jax
+    import numpy as np
+
+    leaves: list = []
+    skeleton = _encode(jax.device_get(tree), leaves)
+    payload = {f"leaf_{i}": leaf for i, leaf in enumerate(leaves)}
+    payload["__structure__"] = np.frombuffer(
+        json.dumps(skeleton).encode(), dtype=np.uint8
+    )
+    tmp = f"{path}.tmp.{os.getpid()}"
+    directory = os.path.dirname(os.path.abspath(path))
+    if directory:
+        os.makedirs(directory, exist_ok=True)
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **payload)
+    os.replace(tmp, path)
+
+
+def load(path: str, device_put: bool = False) -> Any:
+    """Load a pytree saved by :func:`save`. With ``device_put=True`` the
+    leaves are placed on the default device."""
+    import numpy as np
+
+    with np.load(path, allow_pickle=False) as data:
+        skeleton = json.loads(data["__structure__"].tobytes().decode())
+        n = len([k for k in data.files if k.startswith("leaf_")])
+        leaves = [data[f"leaf_{i}"] for i in range(n)]
+    if device_put:
+        import jax
+
+        leaves = [jax.device_put(leaf) for leaf in leaves]
+    return _decode(skeleton, leaves)
+
+
+def save_es_state(path: str, params, key, generation: int,
+                  extra: Any = None) -> None:
+    """Convenience wrapper for the common ES checkpoint shape."""
+    import numpy as np
+
+    save(path, {
+        "params": params,
+        "key": key,
+        "generation": np.asarray(generation),
+        "extra": extra if extra is not None else np.asarray(0),
+    })
+
+
+def load_es_state(path: str):
+    state = load(path)
+    return (
+        state["params"],
+        state["key"],
+        int(state["generation"]),
+        state.get("extra"),
+    )

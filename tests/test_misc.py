@@ -104,3 +104,49 @@ def test_process_repr_states():
     p.start()
     p.join(30)
     assert "stopped[0]" in repr(p)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    import jax
+    import numpy as np
+
+    from fiber_tpu.utils import checkpoint
+
+    tree = {
+        "w": jax.numpy.arange(10.0),
+        "nested": {"b": np.ones((3, 3)), "n": np.asarray(7)},
+    }
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint.save(path, tree)
+    restored = checkpoint.load(path)
+    assert np.allclose(restored["w"], np.arange(10.0))
+    assert np.allclose(restored["nested"]["b"], 1.0)
+    assert int(restored["nested"]["n"]) == 7
+
+
+def test_es_checkpoint_resume(tmp_path):
+    """Save mid-run, restore, continue — generations line up."""
+    import jax
+
+    from fiber_tpu.models import CartPole, MLPPolicy
+    from fiber_tpu.ops import EvolutionStrategy
+    from fiber_tpu.utils import checkpoint
+
+    policy = MLPPolicy(4, 2, hidden=(8,))
+
+    def ef(p, k):
+        return CartPole.rollout(policy.act, p, k, max_steps=50)
+
+    es = EvolutionStrategy(ef, dim=policy.dim, pop_size=16)
+    params = policy.init(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(1)
+    params, _ = es.step(params, key)
+
+    path = str(tmp_path / "es.npz")
+    checkpoint.save_es_state(path, params, key, generation=1)
+    params2, key2, gen, _ = checkpoint.load_es_state(path)
+    assert gen == 1
+    import numpy as np
+
+    assert np.allclose(np.asarray(params), np.asarray(params2))
+    es.step(params2, key2)  # resumes cleanly

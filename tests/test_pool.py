@@ -185,3 +185,11 @@ def test_pi_estimation_smoke():
         inside = sum(pool.map(targets.pi_inside, [1000] * 4))
     pi = 4 * inside / 4000
     assert 2.5 < pi < 3.8
+
+
+def test_pending_table_stress():
+    """Many small chunks through the REQ/REP handout (reference:
+    tests/test_pool.py:247-270 pending-table race, 5000 tasks)."""
+    with make_pool(3) as pool:
+        results = pool.map(targets.square, range(5000), chunksize=16)
+        assert results == [i * i for i in range(5000)]

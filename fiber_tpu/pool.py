@@ -694,22 +694,36 @@ class Pool:
         return self._submit(func, [tuple(args)], 1, True,
                             callback, error_callback, single=True)
 
+    def _device_dispatch(
+        self, func: Callable, items: List[Any], star: bool
+    ) -> Optional[List[Any]]:
+        """Run a @meta(device=True) function on the mesh; None if the
+        function isn't device-hinted. Enforces the same pool-state
+        contract as the host path."""
+        if not get_meta(func).get("device"):
+            return None
+        if self._closed or self._terminated:
+            raise ValueError("Pool not running")
+        try:
+            from fiber_tpu.parallel import device_map
+        except ImportError as err:  # pragma: no cover
+            raise RuntimeError(
+                "@meta(device=True) requires the fiber_tpu.parallel "
+                "device path"
+            ) from err
+        return device_map(func, items, star=star)
+
     def map(
         self,
         func: Callable,
         iterable: Iterable[Any],
         chunksize: Optional[int] = None,
     ) -> List[Any]:
-        if get_meta(func).get("device"):
-            try:
-                from fiber_tpu.parallel import device_map
-            except ImportError as err:  # pragma: no cover
-                raise RuntimeError(
-                    "@meta(device=True) requires the fiber_tpu.parallel "
-                    "device path"
-                ) from err
-            return device_map(func, iterable)
-        return self.map_async(func, iterable, chunksize).get()
+        items = list(iterable)
+        device_out = self._device_dispatch(func, items, star=False)
+        if device_out is not None:
+            return device_out
+        return self.map_async(func, items, chunksize).get()
 
     def map_async(
         self,
@@ -728,7 +742,11 @@ class Pool:
         iterable: Iterable[Tuple],
         chunksize: Optional[int] = None,
     ) -> List[Any]:
-        return self.starmap_async(func, iterable, chunksize).get()
+        items = [tuple(t) for t in iterable]
+        device_out = self._device_dispatch(func, items, star=True)
+        if device_out is not None:
+            return device_out
+        return self.starmap_async(func, items, chunksize).get()
 
     def starmap_async(
         self,

@@ -273,3 +273,35 @@ def test_ring_attention_matches_reference():
         assert np.allclose(got, want, atol=2e-5), (
             causal, np.abs(got - want).max()
         )
+
+
+def test_starmap_device_path():
+    from fiber_tpu.meta import meta
+
+    @meta(device=True)
+    def f(a, b):
+        return a + 2 * b
+
+    with fiber_tpu.Pool(2) as pool:
+        out = pool.starmap(
+            f, [(np.float32(i), np.float32(i + 1)) for i in range(8)]
+        )
+    assert [float(v) for v in out] == [i + 2 * (i + 1) for i in range(8)]
+    assert fiber_tpu.active_children() == []
+
+
+def test_device_path_respects_closed_pool():
+    from fiber_tpu.meta import meta
+
+    @meta(device=True)
+    def f(x):
+        return x
+
+    pool = fiber_tpu.Pool(2)
+    pool.map(f, np.arange(4.0))
+    pool.close()
+    with pytest.raises(ValueError):
+        pool.map(f, np.arange(4.0))
+    with pytest.raises(ValueError):
+        pool.starmap(f, [(np.float32(1),)])
+    pool.join()

@@ -101,3 +101,26 @@ def test_full_stack_process_over_sim_cluster(monkeypatch, tmp_path):
             backend.shutdown_sim_cluster()
         config.get().update(tpu_hosts=old)
         reset_backends()
+
+
+def test_pool_over_sim_cluster(monkeypatch):
+    """Pool.map with workers placed on the simulated pod hosts."""
+    from fiber_tpu import config
+    from fiber_tpu.backends import get_backend, reset_backends
+
+    monkeypatch.setenv("FIBER_BACKEND", "tpu")
+    old = config.get().tpu_hosts
+    config.get().update(tpu_hosts="sim:2")
+    reset_backends()
+    try:
+        with fiber_tpu.Pool(4) as pool:
+            assert pool.map(targets.square, range(40)) == [
+                i * i for i in range(40)
+            ]
+    finally:
+        try:
+            get_backend("tpu").shutdown_sim_cluster()
+        except Exception:
+            pass
+        config.get().update(tpu_hosts=old)
+        reset_backends()

@@ -39,6 +39,9 @@ DEFAULTS: Dict[str, Any] = {
     "ipc_admin_worker_port": 8000,  # used only in passive mode
     # --- data plane ---
     "use_push_queue": True,
+    # Strip accelerator runtime preloads from spawned host workers (faster
+    # interpreter boot; only for workers that never touch the device).
+    "worker_lite": False,
     # --- TPU backend ---
     "tpu_name": "",
     "tpu_zone": "",

@@ -102,6 +102,7 @@ class _Channel:
         self.cid = next(self._ids)
         self.alive = True
         self.credit = 0  # how many frames the peer is ready to accept
+        self.replenish_owed = 0  # batched standing-window replenish
         self._send_lock = threading.Lock()
         sock.setsockopt(pysocket.IPPROTO_TCP, pysocket.TCP_NODELAY, 1)
         self._reader: Optional[threading.Thread] = None
@@ -325,11 +326,21 @@ class Endpoint:
                 self._waiting_readers -= 1
             self._maybe_grant()  # top up for any other blocked readers
         elif self.mode == "r":
-            # Bound ingress: replenish the standing window.
-            try:
-                chan.send_credit(1)
-            except OSError:
-                pass
+            # Bound ingress: replenish the standing window, batched — one
+            # credit frame per 32 data frames instead of per frame (the
+            # window is 4096, so senders never starve on the float). The
+            # counter is guarded: concurrent recv() callers must not lose
+            # increments (each loss permanently shrinks the window).
+            owed = 0
+            with self._recv_lock:
+                chan.replenish_owed += 1
+                if chan.replenish_owed >= 32:
+                    owed, chan.replenish_owed = chan.replenish_owed, 0
+            if owed:
+                try:
+                    chan.send_credit(owed)
+                except OSError:
+                    pass
         if self.mode == "rep":
             self._reply_to = chan
         return frame

@@ -1,0 +1,26 @@
+# Test matrix (reference parity: test_local.sh / test.sh /
+# test_kubernetes.sh run one suite against three backend tiers).
+
+PYTEST ?= python -m pytest tests/ -q
+
+.PHONY: test stest test-all lint bench
+
+# Tier 1: local backend (subprocess jobs)
+test:
+	$(PYTEST)
+
+# Tier 2: simulated multi-host pod slice (host agents on localhost —
+# the reference's Docker-backend role)
+stest:
+	FIBER_BACKEND=tpu FIBER_TPU_HOSTS=sim:2 $(PYTEST)
+
+# Tier 3 runs on a real pod slice: start agents with `fiber-tpu up`,
+# then FIBER_BACKEND=tpu FIBER_TPU_HOSTS=host1,host2 make test
+
+test-all: test stest
+
+bench:
+	python bench.py
+
+lint:
+	python -m compileall -q fiber_tpu examples bench.py __graft_entry__.py

@@ -79,16 +79,25 @@ class HostRing:
     """
 
     def __init__(self, rank: int, size: int,
-                 addrs: Sequence[Tuple[str, int]]) -> None:
+                 addrs: Sequence[Tuple[str, int]],
+                 listener: Optional[pysocket.socket] = None) -> None:
         if size < 2:
+            if listener is not None:
+                listener.close()
             raise ValueError("HostRing needs size >= 2")
         self.rank = rank
         self.size = size
         ip, port = addrs[rank]
-        listener = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_STREAM)
-        listener.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_REUSEADDR, 1)
-        listener.bind(("", port))
-        listener.listen(2)
+        if listener is None:
+            # Prefer a pre-bound listener (see Ring's rendezvous: binding
+            # before advertising eliminates port races between ranks that
+            # share a machine).
+            listener = pysocket.socket(pysocket.AF_INET,
+                                       pysocket.SOCK_STREAM)
+            listener.setsockopt(pysocket.SOL_SOCKET,
+                                pysocket.SO_REUSEADDR, 1)
+            listener.bind(("", port))
+            listener.listen(2)
 
         next_ip, next_port = addrs[(rank + 1) % size]
         self._next: Optional[pysocket.socket] = None

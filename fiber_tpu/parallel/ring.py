@@ -34,6 +34,15 @@ class RingNode:
 
 
 _current_ring = None
+_pending_listener = None  # pre-bound rendezvous listener for this rank
+
+
+def take_pending_listener():
+    """The listener this rank bound before advertising its port (consumed
+    by default_initializer; None if the rendezvous didn't pre-bind)."""
+    global _pending_listener
+    listener, _pending_listener = _pending_listener, None
+    return listener
 
 
 def current_ring():
@@ -50,7 +59,14 @@ def default_initializer(rank: int, size: int,
     global _current_ring
     from fiber_tpu.ops.collectives import HostRing
 
-    _current_ring = HostRing(rank, size, addrs)
+    _current_ring = HostRing(rank, size, addrs,
+                             listener=take_pending_listener())
+
+
+# Marks initializers that consume the pre-bound rendezvous listener; all
+# others (e.g. jax_distributed_initializer, whose coordinator must bind
+# the advertised port itself) get an unbound advertised port instead.
+default_initializer._prebind = True  # type: ignore[attr-defined]
 
 
 def jax_distributed_initializer(rank: int, size: int,
@@ -70,10 +86,27 @@ def jax_distributed_initializer(rank: int, size: int,
 
 def _ring_target(rank: int, size: int, nodes_proxy, func: Callable,
                  initializer: Optional[Callable]) -> None:
+    import socket as pysocket
+
     from fiber_tpu.backends import get_backend
 
+    global _pending_listener
+
     ip, _, _ = get_backend().get_listen_addr()
-    port = random.randint(30000, 50000)  # reference port policy (ring.py:91-98)
+    if getattr(initializer, "_prebind", False):
+        # Bind BEFORE advertising: the reference advertises a random port
+        # and binds later (ring.py:91-98), which races when ranks share a
+        # machine. Only for initializers that consume the listener.
+        listener = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_STREAM)
+        listener.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_REUSEADDR, 1)
+        listener.bind(("", 0))
+        listener.listen(2)
+        port = listener.getsockname()[1]
+        _pending_listener = listener
+    else:
+        # The consumer (e.g. jax.distributed's coordinator) binds the
+        # advertised port itself — it must be free, not squatted.
+        port = random.randint(30000, 50000)
     nodes_proxy[rank] = RingNode(rank, ip, port)
 
     deadline = time.monotonic() + 120
@@ -89,6 +122,9 @@ def _ring_target(rank: int, size: int, nodes_proxy, func: Callable,
 
     if initializer is not None:
         initializer(rank, size, addrs)
+    leftover = take_pending_listener()
+    if leftover is not None:  # initializer didn't consume it: release
+        leftover.close()
     func(rank, size)
 
 

@@ -157,7 +157,7 @@ def test_create_job_timeout_retry():
     (reference: TimeoutBackend, tests/test_process.py:27-39,180-190)."""
     from fiber_tpu.backends import get_backend
 
-    backend = get_backend("local")
+    backend = get_backend()  # active backend tier
     orig = backend.create_job
     state = {"fails": 2}
 

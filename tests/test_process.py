@@ -143,7 +143,7 @@ def test_process_start_failure_surfaces_logs():
     from fiber_tpu.launcher import ProcessStartError
     from fiber_tpu.core import Job, JobSpec
 
-    backend = get_backend("local")
+    backend = get_backend()  # whichever backend tier this run uses
     orig = backend.create_job
 
     def broken_create(spec: JobSpec):

@@ -52,11 +52,16 @@ class EvolutionStrategy:
         mesh=None,
         weight_decay: float = 0.0,
         use_pallas: str | bool = "auto",
+        optimizer: str = "sgd",
     ) -> None:
         import numpy as np
 
         from fiber_tpu.parallel.mesh import default_mesh
 
+        if optimizer not in ("sgd", "adam"):
+            raise ValueError(f"unknown optimizer {optimizer!r}")
+        self.optimizer = optimizer
+        self._opt_state = None  # adam (m, v, t), device-resident
         self.eval_fn = eval_fn
         self.dim = dim
         self.sigma = float(sigma)
@@ -104,8 +109,13 @@ class EvolutionStrategy:
             perturb_fn = build_perturb(pairs, dim, sigma)
             wsum_fn = build_weighted_eps_sum(pairs, dim)
 
-        def device_step(params, key):
-            # params (dim,) replicated; key replicated
+        adam = self.optimizer == "adam"
+        b1, b2, eps_adam = 0.9, 0.999, 1e-8
+
+        def device_step(params, m, v, t, key):
+            # params (dim,) replicated; key replicated. In sgd mode the
+            # (m, v, t) slots are zero-size placeholders (see step()) so
+            # no dead state rides the jitted program.
             my = jax.lax.axis_index("pool")
             dev_key = jax.random.fold_in(key, my)
             eps_key, eval_key = jax.random.split(dev_key)
@@ -140,28 +150,73 @@ class EvolutionStrategy:
             else:
                 g_local = w @ eps                      # (dim,) on the MXU
             grad = jax.lax.psum(g_local, "pool") / (pop * sigma)
-            new_params = params + lr * grad - lr * wd * params
+            if adam:
+                # Ascent-direction Adam (OpenAI-ES uses Adam on the
+                # estimated gradient); state is replicated like params.
+                t_new = t + 1.0
+                m_new = b1 * m + (1 - b1) * grad
+                v_new = b2 * v + (1 - b2) * grad * grad
+                m_hat = m_new / (1 - b1 ** t_new)
+                v_hat = v_new / (1 - b2 ** t_new)
+                update = lr * m_hat / (jnp.sqrt(v_hat) + eps_adam)
+            else:
+                t_new, m_new, v_new = t, m, v
+                update = lr * grad
+            # Decoupled weight decay: applied to params directly, never
+            # routed through the adaptive moments (AdamW-style).
+            new_params = params + update - lr * wd * params
             stats = jnp.stack([
                 flat_fit.mean(),
                 flat_fit.max(),
                 jax.lax.pmean(fitness.mean(), "pool"),
             ])
-            return new_params, stats
+            return new_params, m_new, v_new, t_new, stats
 
         stepped = shard_map(
             device_step,
             mesh=self.mesh,
-            in_specs=(P(), P()),
-            out_specs=(P(), P()),
+            in_specs=(P(), P(), P(), P(), P()),
+            out_specs=(P(), P(), P(), P(), P()),
             check_vma=False,
         )
         return jax.jit(stepped)
 
     # ------------------------------------------------------------------
+    def _ensure_opt_state(self, params):
+        import jax.numpy as jnp
+
+        if self.optimizer != "adam":
+            # sgd carries no state: zero-size placeholders keep the step
+            # signature uniform without copying dead (dim,) buffers.
+            zero = jnp.zeros((0,), jnp.float32)
+            return (zero, zero, jnp.asarray(0.0))
+        if self._opt_state is None:
+            zeros = jnp.zeros_like(params)
+            self._opt_state = (zeros, zeros, jnp.asarray(0.0))
+        elif self._opt_state[0].shape != params.shape:
+            raise ValueError(
+                "optimizer state shape "
+                f"{self._opt_state[0].shape} does not match params "
+                f"{params.shape}: one EvolutionStrategy instance tracks "
+                "ONE population's Adam state — call reset_optimizer() "
+                "when switching populations, or use separate instances"
+            )
+        return self._opt_state
+
+    def reset_optimizer(self) -> None:
+        self._opt_state = None
+
     def step(self, params, key):
         """One generation: returns (new_params, stats) where stats is
-        [mean_fitness, max_fitness, mean_fitness_again]."""
-        return self._step(params, key)
+        [mean_fitness, max_fitness, mean_fitness_again]. Adam state lives
+        on the mesh inside this object and is keyed to ONE population —
+        don't interleave different parameter vectors through a shared
+        adam-mode instance (POET shares an instance but uses sgd)."""
+        m, v, t = self._ensure_opt_state(params)
+        new_params, m, v, t, stats = self._step(params, m, v, t, key)
+        if self.optimizer == "adam":
+            self._opt_state = (m, v, t)
+        return new_params, stats
 
     def run(self, params, key, generations: int,
             log_every: int = 0) -> Tuple[object, list]:

@@ -187,9 +187,17 @@ class EvolutionStrategy:
 
         if self.optimizer != "adam":
             # sgd carries no state: zero-size placeholders keep the step
-            # signature uniform without copying dead (dim,) buffers.
-            zero = jnp.zeros((0,), jnp.float32)
-            return (zero, zero, jnp.asarray(0.0))
+            # signature uniform; cached so the hot loop allocates nothing.
+            if self._opt_state is None:
+                zero = jnp.zeros((0,), jnp.float32)
+                self._opt_state = (zero, zero, jnp.asarray(0.0))
+            return self._opt_state
+        if params.shape != (self.dim,):
+            # Validate before touching state: a bad call must not poison
+            # the instance for subsequent correct calls.
+            raise ValueError(
+                f"params shape {params.shape} != ({self.dim},)"
+            )
         if self._opt_state is None:
             zeros = jnp.zeros_like(params)
             self._opt_state = (zeros, zeros, jnp.asarray(0.0))

@@ -210,3 +210,15 @@ def ring_sgd_step(rank, size):
     expected = -0.3 * (size + 1) / 2
     assert np.allclose(w, expected), (rank, w[0], expected)
     ring.close()
+
+
+def jax_array_doubler(q_in, q_out):
+    """Receives jax arrays through a queue (custom reducer path),
+    computes, ships back."""
+    import jax.numpy as jnp
+
+    while True:
+        item = q_in.get()
+        if item is None:
+            return
+        q_out.put(jnp.asarray(item) * 2)

@@ -130,3 +130,24 @@ def test_round_robin_fairness_across_processes():
     assert counts == {i: per_worker for i in range(n_workers)}
     q.close()
     q_result.close()
+
+
+def test_jax_arrays_through_queue():
+    """jax.Array rides the host plane via the custom reducer
+    (device -> host numpy -> device; fiber_tpu/serialization.py)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    q_in, q_out = fiber_tpu.SimpleQueue(), fiber_tpu.SimpleQueue()
+    p = fiber_tpu.Process(target=targets.jax_array_doubler,
+                          args=(q_in, q_out))
+    p.start()
+    arr = jnp.arange(8.0)
+    q_in.put(arr)
+    result = q_out.get(60)
+    assert np.allclose(np.asarray(result), np.arange(8.0) * 2)
+    q_in.put(None)
+    p.join(30)
+    assert p.exitcode == 0
+    q_in.close()
+    q_out.close()

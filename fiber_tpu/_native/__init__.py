@@ -105,6 +105,28 @@ def load() -> Optional[ctypes.CDLL]:
         lib.fiber_pump_close.argtypes = [ctypes.c_void_p]
         lib.fiber_pump_peers.restype = ctypes.c_int
         lib.fiber_pump_peers.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.nq_connect.restype = ctypes.c_void_p
+        lib.nq_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                   ctypes.c_int, ctypes.c_int]
+        lib.nq_shutdown.restype = None
+        lib.nq_shutdown.argtypes = [ctypes.c_void_p]
+        lib.nq_send.restype = ctypes.c_int
+        lib.nq_send.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_uint64]
+        lib.nq_recv.restype = ctypes.c_int
+        lib.nq_recv.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.nq_free.restype = None
+        lib.nq_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.nq_poll.restype = ctypes.c_int
+        lib.nq_poll.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.nq_fileno.restype = ctypes.c_int
+        lib.nq_fileno.argtypes = [ctypes.c_void_p]
+        lib.nq_close.restype = None
+        lib.nq_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -154,3 +176,87 @@ class NativePump:
 
 def available() -> bool:
     return load() is not None
+
+
+_MODE_CODES = {"r": 0, "w": 1, "rw": 2}
+
+
+class NativeClient:
+    """Connection-side native transport: framing, socket IO, and the
+    credit protocol all in C (one ctypes call per send/recv; the GIL is
+    released during blocking calls). Modes r/w/rw.
+
+    Thread semantics match ``multiprocessing.connection.Connection``: one
+    operation at a time (serialized by an internal lock). ``close()`` is
+    safe while another thread is blocked in recv/send — the blocked call
+    wakes with OSError before the handle is freed."""
+
+    CONNECT_TIMEOUT_MS = 30_000
+
+    def __init__(self, host: str, port: int, mode: str) -> None:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native client unavailable")
+        code = _MODE_CODES.get(mode)
+        if code is None:
+            raise ValueError(f"native client does not support mode {mode!r}")
+        handle = lib.nq_connect(host.encode(), port, code,
+                                self.CONNECT_TIMEOUT_MS)
+        if not handle:
+            raise OSError(f"nq_connect failed for {host}:{port}")
+        self._lib = lib
+        self._handle = handle
+        self._op_lock = threading.Lock()
+        self._closed = False
+
+    def send(self, payload: bytes) -> None:
+        with self._op_lock:
+            if self._closed:
+                raise OSError("connection closed")
+            if self._lib.nq_send(self._handle, payload, len(payload)) != 0:
+                raise OSError("native send failed (peer closed)")
+
+    def recv(self, timeout: Optional[float] = None) -> bytes:
+        timeout_ms = -1 if timeout is None else max(0, int(timeout * 1000))
+        with self._op_lock:
+            if self._closed:
+                raise OSError("connection closed")
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            out_len = ctypes.c_uint64()
+            rc = self._lib.nq_recv(self._handle, timeout_ms,
+                                   ctypes.byref(out), ctypes.byref(out_len))
+            if rc == 0:
+                raise TimeoutError("recv timed out")
+            if rc != 1:
+                raise OSError("native recv failed (peer closed)")
+            try:
+                return ctypes.string_at(out, out_len.value)
+            finally:
+                self._lib.nq_free(out)
+
+    def poll(self, timeout: Optional[float] = 0.0) -> bool:
+        timeout_ms = -1 if timeout is None else max(0, int(timeout * 1000))
+        with self._op_lock:
+            if self._closed:
+                return False
+            return self._lib.nq_poll(self._handle, timeout_ms) == 1
+
+    def fileno(self) -> int:
+        return self._lib.nq_fileno(self._handle)
+
+    def close(self) -> None:
+        if self._closed or not self._handle:
+            return
+        self._closed = True
+        # Wake any blocked operation first (shutdown is handle-safe), then
+        # free once the in-flight call has released the lock.
+        self._lib.nq_shutdown(self._handle)
+        with self._op_lock:
+            self._lib.nq_close(self._handle)
+            self._handle = None
+
+    def __del__(self) -> None:  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

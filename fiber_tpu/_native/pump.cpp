@@ -387,3 +387,228 @@ void fiber_pump_close(void* handle) {
 }
 
 }  // extern "C"
+
+// ───────────────────────────────────────────────────────────────────────
+// Native queue client: the connection-side counterpart of the pump.
+// One handle per Connection; blocking calls (Python's ctypes releases the
+// GIL, so other threads keep running). Modes: 0 = r (demand-driven
+// consumer: grants one credit when entering recv), 1 = w (producer:
+// honors the bound endpoint's standing credit window), 2 = rw (pipe end,
+// no credit protocol).
+
+#include <cstdlib>
+#include <poll.h>
+
+namespace {
+
+struct Client {
+  int fd = -1;
+  int mode = 0;            // 0 r, 1 w, 2 rw
+  uint64_t credit = 0;     // w-mode: frames the peer will accept
+  int credit_outstanding = 0;  // r-mode: granted but undelivered
+  std::vector<uint8_t> rbuf;
+  size_t rpos = 0;
+};
+
+bool send_all(int fd, const uint8_t* p, size_t n) {
+  while (n > 0) {
+    ssize_t sent = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (sent < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += size_t(sent);
+    n -= size_t(sent);
+  }
+  return true;
+}
+
+bool client_send_frame(Client* c, const uint8_t* payload, uint64_t len,
+                       uint8_t type) {
+  uint8_t header[9];
+  put_be64(header, len + 1);
+  header[8] = type;
+  if (!send_all(c->fd, header, 9)) return false;
+  if (len > 0 && !send_all(c->fd, payload, len)) return false;
+  return true;
+}
+
+bool client_send_credit(Client* c, uint32_t n) {
+  uint8_t body[4] = {
+      uint8_t(n >> 24), uint8_t(n >> 16), uint8_t(n >> 8), uint8_t(n)};
+  return client_send_frame(c, body, 4, kCredit);
+}
+
+// Read one complete frame; returns 1 ok, 0 timeout, -1 closed/error. A
+// timeout mid-frame is safe: the partial bytes stay in rbuf and the next
+// call resumes exactly where this one stopped. Frame body (without the
+// type byte) is returned via malloc into *out/*out_len.
+int client_read_frame(Client* c, int timeout_ms, uint8_t* type_out,
+                      uint8_t** out, uint64_t* out_len) {
+  for (;;) {
+    // parse attempt
+    size_t avail = c->rbuf.size() - c->rpos;
+    if (avail >= 8) {
+      uint64_t flen = be64(c->rbuf.data() + c->rpos);
+      if (flen > kMaxFrame || flen < 1) return -1;
+      if (avail >= 8 + flen) {
+        const uint8_t* body = c->rbuf.data() + c->rpos + 8;
+        *type_out = body[0];
+        *out_len = flen - 1;
+        *out = (uint8_t*)malloc(flen - 1 ? flen - 1 : 1);
+        memcpy(*out, body + 1, flen - 1);
+        c->rpos += 8 + flen;
+        if (c->rpos == c->rbuf.size()) {
+          c->rbuf.clear();
+          c->rpos = 0;
+        } else if (c->rpos > (1 << 20)) {
+          c->rbuf.erase(c->rbuf.begin(), c->rbuf.begin() + c->rpos);
+          c->rpos = 0;
+        }
+        return 1;
+      }
+    }
+    if (timeout_ms >= 0) {
+      struct pollfd pfd{c->fd, POLLIN, 0};
+      int rc = ::poll(&pfd, 1, timeout_ms);
+      if (rc == 0) return 0;
+      if (rc < 0 && errno != EINTR) return -1;
+    }
+    uint8_t chunk[1 << 16];
+    ssize_t got = ::recv(c->fd, chunk, sizeof chunk, 0);
+    if (got == 0) return -1;
+    if (got < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    c->rbuf.insert(c->rbuf.end(), chunk, chunk + got);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* nq_connect(const char* host, int port, int mode, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return nullptr;
+  }
+  int rc = ::connect(fd, (sockaddr*)&addr, sizeof addr);
+  if (rc < 0 && errno == EINPROGRESS) {
+    struct pollfd pfd{fd, POLLOUT, 0};
+    if (::poll(&pfd, 1, timeout_ms) <= 0) {
+      ::close(fd);
+      return nullptr;
+    }
+    int err = 0;
+    socklen_t elen = sizeof err;
+    getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+    if (err != 0) {
+      ::close(fd);
+      return nullptr;
+    }
+  } else if (rc < 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  // back to blocking mode for the data path
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags & ~O_NONBLOCK);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  Client* c = new Client();
+  c->fd = fd;
+  c->mode = mode;
+  return c;
+}
+
+// Wake any thread blocked in nq_recv/nq_send on this handle (they see a
+// closed stream); safe to call concurrently with in-flight operations.
+// The handle itself must still be freed with nq_close afterwards.
+void nq_shutdown(void* handle) {
+  Client* c = static_cast<Client*>(handle);
+  ::shutdown(c->fd, SHUT_RDWR);
+}
+
+// Send one data frame. w-mode blocks until the peer has granted credit.
+// Returns 0 ok, -1 closed/error.
+int nq_send(void* handle, const uint8_t* payload, uint64_t len) {
+  Client* c = static_cast<Client*>(handle);
+  if (c->mode == 1) {
+    while (c->credit == 0) {
+      uint8_t type;
+      uint8_t* body = nullptr;
+      uint64_t blen = 0;
+      int rc = client_read_frame(c, -1, &type, &body, &blen);
+      if (rc != 1) return -1;
+      if (type == kCredit && blen >= 4) {
+        c->credit += be32(body);
+      }
+      free(body);
+    }
+    c->credit--;
+  }
+  return client_send_frame(c, payload, len, 0x00) ? 0 : -1;
+}
+
+// Receive one data frame. r-mode grants a demand credit on entry.
+// timeout_ms < 0 = block forever. Returns 1 ok, 0 timeout, -1 closed.
+int nq_recv(void* handle, int timeout_ms, uint8_t** out,
+            uint64_t* out_len) {
+  Client* c = static_cast<Client*>(handle);
+  if (c->mode == 0 && c->credit_outstanding == 0) {
+    if (!client_send_credit(c, 1)) return -1;
+    c->credit_outstanding = 1;
+  }
+  for (;;) {
+    uint8_t type;
+    int rc = client_read_frame(c, timeout_ms, &type, out, out_len);
+    if (rc != 1) return rc;
+    if (type == 0x00) {
+      if (c->mode == 0) c->credit_outstanding = 0;
+      return 1;
+    }
+    if (type == 0x01 && *out_len >= 4) c->credit += be32(*out);
+    free(*out);  // credit/unknown frame: keep reading
+  }
+}
+
+void nq_free(uint8_t* ptr) { free(ptr); }
+
+int nq_fileno(void* handle) {
+  return static_cast<Client*>(handle)->fd;
+}
+
+// True if a data frame is already buffered or arrives within timeout_ms,
+// WITHOUT consuming it... (conservative: peeks only at buffered bytes +
+// socket readability; a readable socket may hold only credit frames,
+// which recv() skips). 1 ready, 0 not, -1 closed.
+int nq_poll(void* handle, int timeout_ms) {
+  Client* c = static_cast<Client*>(handle);
+  if (c->rbuf.size() - c->rpos >= 9) return 1;
+  // Demand-driven consumers must ask before anything can arrive — a poll
+  // without a granted credit would always time out (the canonical
+  // "if conn.poll(t): conn.recv()" pattern depends on this).
+  if (c->mode == 0 && c->credit_outstanding == 0) {
+    if (!client_send_credit(c, 1)) return -1;
+    c->credit_outstanding = 1;
+  }
+  struct pollfd pfd{c->fd, POLLIN, 0};
+  int rc = ::poll(&pfd, 1, timeout_ms);
+  if (rc < 0) return -1;
+  return rc > 0 ? 1 : 0;
+}
+
+void nq_close(void* handle) {
+  Client* c = static_cast<Client*>(handle);
+  ::close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

@@ -46,14 +46,30 @@ class Connection:
         self._lock = threading.Lock()
 
     # -- wiring -----------------------------------------------------------
-    def _endpoint(self) -> Endpoint:
+    def _endpoint(self):
+        """The underlying transport: the native C client (framing + socket
+        + credit protocol in one ctypes call per op) when available, else
+        a Python Endpoint. Both expose send/recv/poll/fileno/close."""
         if self._ep is None:
             with self._lock:
                 if self._ep is None:
-                    ep = Endpoint(self._mode)
-                    ep.connect(self._addr)
-                    self._ep = ep
+                    self._ep = self._connect_impl()
         return self._ep
+
+    def _connect_impl(self):
+        from fiber_tpu.transport.tcp import parse_addr
+
+        host, port = parse_addr(self._addr)
+        try:
+            from fiber_tpu._native import NativeClient, available
+
+            if available() and host.replace(".", "").isdigit():
+                return NativeClient(host, port, self._mode)
+        except Exception:
+            pass
+        ep = Endpoint(self._mode)
+        ep.connect(self._addr)
+        return ep
 
     # -- data -------------------------------------------------------------
     def send_bytes(self, payload: bytes) -> None:

@@ -1,0 +1,49 @@
+"""Native extension build + client/pump engagement.
+
+The loader falls back to pure Python silently (by design, for machines
+without a toolchain) — these tests make a broken pump.cpp loud where g++
+exists instead of letting the fallback mask it.
+"""
+
+import shutil
+
+import pytest
+
+import fiber_tpu  # noqa: F401
+from tests import targets  # noqa: F401
+
+needs_gxx = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="no C++ toolchain"
+)
+
+
+@needs_gxx
+def test_native_library_builds_and_loads():
+    from fiber_tpu import _native
+
+    assert _native.available(), "pump.cpp failed to build/load"
+
+
+@needs_gxx
+def test_native_client_engaged_for_queue_connections():
+    from fiber_tpu._native import NativeClient
+
+    q = fiber_tpu.SimpleQueue()
+    try:
+        q.put("hello")
+        reader = q._get_reader()
+        assert q.get(10) == "hello"
+        assert isinstance(reader._endpoint(), NativeClient)
+        writer = q._get_writer()
+        assert isinstance(writer._endpoint(), NativeClient)
+    finally:
+        q.close()
+
+
+@needs_gxx
+def test_native_device_engaged():
+    q = fiber_tpu.SimpleQueue()
+    try:
+        assert q._device.is_native
+    finally:
+        q.close()

@@ -363,11 +363,13 @@ def _pool_worker_core(
     fiber_pid = fprocess.current_process().pid or os.getpid()
     funcs = _FuncCache()
 
-    result_ep = Endpoint("w").connect(result_addr)
+    from fiber_tpu.transport.tcp import connect_transport
+
+    result_ep = connect_transport("w", result_addr)
     if resilient:
-        task_ep = Endpoint("req").connect(task_addr)
+        task_ep = connect_transport("req", task_addr)
     else:
-        task_ep = Endpoint("r").connect(task_addr)
+        task_ep = connect_transport("r", task_addr)
 
     completed_chunks = 0
     try:

@@ -57,19 +57,9 @@ class Connection:
         return self._ep
 
     def _connect_impl(self):
-        from fiber_tpu.transport.tcp import parse_addr
+        from fiber_tpu.transport.tcp import connect_transport
 
-        host, port = parse_addr(self._addr)
-        try:
-            from fiber_tpu._native import NativeClient, available
-
-            if available() and host.replace(".", "").isdigit():
-                return NativeClient(host, port, self._mode)
-        except Exception:
-            pass
-        ep = Endpoint(self._mode)
-        ep.connect(self._addr)
-        return ep
+        return connect_transport(self._mode, self._addr)
 
     # -- data -------------------------------------------------------------
     def send_bytes(self, payload: bytes) -> None:

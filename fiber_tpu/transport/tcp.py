@@ -420,6 +420,31 @@ def parse_addr(addr: str) -> Tuple[str, int]:
     return host, int(port_s)
 
 
+#: Modes the native C client supports, with REQ mapped onto rw (same wire
+#: framing: no credit protocol on req/rep exchanges).
+_NATIVE_MODE_MAP = {"r": "r", "w": "w", "rw": "rw", "req": "rw"}
+
+
+def connect_transport(mode: str, addr: str):
+    """The one place that picks a connection-side transport: the native C
+    client (framing + socket + credit protocol per ctypes call) when the
+    library loads and the address is a numeric IPv4, else a Python
+    Endpoint. Used by queue/pipe Connections and pool workers alike so
+    the selection policy can never diverge."""
+    host, port = parse_addr(addr)
+    native_mode = _NATIVE_MODE_MAP.get(mode)
+    if native_mode is not None and host.count(".") == 3 and \
+            host.replace(".", "").isdigit():
+        try:
+            from fiber_tpu._native import NativeClient, available
+
+            if available():
+                return NativeClient(host, port, native_mode)
+        except Exception:
+            pass
+    return Endpoint(mode).connect(addr)
+
+
 class Device:
     """A forwarder bound to two stable addresses (reference: the nanomsg
     ``nn_device`` under every queue, fiber/socket.py:297-320).

@@ -75,15 +75,25 @@ def test_psum_sharded():
 
 
 def test_host_ring_allreduce_threads():
-    """3 ranks as threads over localhost TCP."""
+    """3 ranks as threads over localhost TCP (pre-bound port-0 listeners:
+    fixed ports collide with the transport's random 40000-65535 range)."""
+    import socket as pysocket
+
     size = 3
-    addrs = [("127.0.0.1", 42100 + i) for i in range(size)]
+    listeners = []
+    addrs = []
+    for _ in range(size):
+        lst = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_STREAM)
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(2)
+        listeners.append(lst)
+        addrs.append(("127.0.0.1", lst.getsockname()[1]))
     results = [None] * size
     errors = []
 
     def worker(rank):
         try:
-            ring = HostRing(rank, size, addrs)
+            ring = HostRing(rank, size, addrs, listener=listeners[rank])
             arr = np.full(1000, float(rank + 1), dtype=np.float32)
             results[rank] = ring.allreduce(arr)
             ring.close()

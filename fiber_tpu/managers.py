@@ -163,9 +163,16 @@ def _run_server(registry, writer, authkey) -> None:
 
 class BaseProxy:
     """Synchronous proxy: one lazily-opened, lock-serialized connection per
-    proxy instance per process; picklable as (address, ident, typeid)."""
+    proxy instance per process; picklable as (address, ident, typeid).
+
+    Proxies for *blocking* primitives set ``_per_thread_conn = True``: each
+    user thread then gets its own connection (and therefore its own server
+    thread), which (a) lets another thread release/abort while one blocks
+    in acquire()/wait() on the same proxy, and (b) maps thread ownership
+    (RLock reentrancy) onto server threads correctly."""
 
     _exposed_: Tuple[str, ...] = ()
+    _per_thread_conn = False
 
     def __init__(self, address, ident: int, typeid: str,
                  authkey: Optional[bytes] = None) -> None:
@@ -175,6 +182,7 @@ class BaseProxy:
         self._authkey = authkey
         self._conn = None
         self._conn_lock = threading.Lock()
+        self._tl = threading.local()
 
     def _resolve_authkey(self) -> bytes:
         if self._authkey is not None:
@@ -183,13 +191,24 @@ class BaseProxy:
 
         return bytes(current_process().authkey)
 
-    def _call(self, method: str, *args: Any, **kwargs: Any) -> Any:
+    def _get_conn(self):
+        if self._per_thread_conn:
+            if getattr(self._tl, "conn", None) is None:
+                self._tl.conn = Client(self._address,
+                                       authkey=self._resolve_authkey())
+                self._tl.lock = threading.Lock()
+            return self._tl.conn, self._tl.lock
         with self._conn_lock:
             if self._conn is None:
                 self._conn = Client(self._address,
                                     authkey=self._resolve_authkey())
-            self._conn.send((self._ident, method, args, kwargs))
-            ok, payload = self._conn.recv()
+        return self._conn, self._conn_lock
+
+    def _call(self, method: str, *args: Any, **kwargs: Any) -> Any:
+        conn, lock = self._get_conn()
+        with lock:
+            conn.send((self._ident, method, args, kwargs))
+            ok, payload = conn.recv()
         if ok:
             return payload
         exc, tb = payload
@@ -253,12 +272,35 @@ _QUEUE_METHODS = ("put", "get", "put_nowait", "get_nowait", "qsize",
                   "empty", "full")
 _JQUEUE_METHODS = _QUEUE_METHODS + ("task_done", "join")
 _EVENT_METHODS = ("set", "clear", "is_set", "wait")
+_LOCK_METHODS = ("acquire", "release")
+_BARRIER_METHODS = ("wait", "reset", "abort")
 
 ListProxy = MakeProxyType("ListProxy", _LIST_METHODS)
 DictProxy = MakeProxyType("DictProxy", _DICT_METHODS)
 QueueProxy = MakeProxyType("QueueProxy", _QUEUE_METHODS)
 JoinableQueueProxy = MakeProxyType("JoinableQueueProxy", _JQUEUE_METHODS)
 EventProxy = MakeProxyType("EventProxy", _EVENT_METHODS)
+class BarrierProxy(MakeProxyType("_BarrierProxyBase", _BARRIER_METHODS)):
+    _per_thread_conn = True  # abort() must work while wait() blocks
+
+
+class LockProxy(MakeProxyType("_LockProxyBase", _LOCK_METHODS)):
+    """Distributed lock/semaphore: context-manager capable. Per-thread
+    connections give each user thread its own server thread, so blocking
+    acquires don't wedge the proxy and RLock ownership/reentrancy follows
+    the calling thread."""
+
+    _per_thread_conn = True
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc_info):
+        self.release()
+
+
+SemaphoreProxy = LockProxy  # same surface: acquire/release + `with`
 _ValueProxyBase = MakeProxyType("_ValueProxyBase", ("get", "set"))
 ArrayProxy = MakeProxyType("ArrayProxy", (
     "__getitem__", "__setitem__", "__len__",
@@ -485,6 +527,12 @@ class SyncManager(BaseManager):
 SyncManager.register("Queue", pyqueue.Queue, QueueProxy)
 SyncManager.register("JoinableQueue", pyqueue.Queue, JoinableQueueProxy)
 SyncManager.register("Event", threading.Event, EventProxy)
+SyncManager.register("Lock", threading.Lock, LockProxy)
+SyncManager.register("RLock", threading.RLock, LockProxy)
+SyncManager.register("Semaphore", threading.Semaphore, SemaphoreProxy)
+SyncManager.register("BoundedSemaphore", threading.BoundedSemaphore,
+                     SemaphoreProxy)
+SyncManager.register("Barrier", threading.Barrier, BarrierProxy)
 SyncManager.register("list", list, ListProxyIter)
 SyncManager.register("dict", dict, DictProxyIter)
 SyncManager.register("Namespace", Namespace, NamespaceProxy)
@@ -505,6 +553,11 @@ def _register_async(typeid: str, factory: Callable,
 
 
 for _tid, (_fac, _proxy) in list(SyncManager._registry.items()):
+    if _tid == "RLock":
+        # Async RLock is unsound: overlapping calls ride different pooled
+        # connections (different server threads), so ownership/reentrancy
+        # can't be honored. Use the sync manager for locks.
+        continue
     _register_async(_tid, _fac, _proxy)
 
 # A generic callable wrapper so AsyncManager can host arbitrary user

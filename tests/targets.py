@@ -222,3 +222,18 @@ def jax_array_doubler(q_in, q_out):
         if item is None:
             return
         q_out.put(jnp.asarray(item) * 2)
+
+
+def locked_increment(lock, ns, n):
+    """Read-modify-write under a distributed manager lock."""
+    for _ in range(n):
+        with lock:
+            ns.counter = ns.counter + 1
+
+
+def barrier_then_report(barrier, q, tag):
+
+
+    t0 = time.time()
+    barrier.wait()
+    q.put((tag, time.time() - t0))

@@ -193,3 +193,11 @@ def test_pending_table_stress():
     with make_pool(3) as pool:
         results = pool.map(targets.square, range(5000), chunksize=16)
         assert results == [i * i for i in range(5000)]
+
+
+def test_maxtasksperchild_restarts_workers():
+    """Workers exit after N chunks and get replaced; the map completes
+    (reference Pool semantics)."""
+    with fiber_tpu.Pool(2, maxtasksperchild=2) as pool:
+        results = pool.map(targets.square, range(40), chunksize=2)
+        assert results == [i * i for i in range(40)]

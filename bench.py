@@ -30,10 +30,25 @@ def _emit(result: dict) -> None:
     print(json.dumps(result), flush=True)
 
 
-def _watchdog(seconds: float, payload: dict):
-    """Emit a failure line and hard-exit if the accelerator wedges."""
+def _watchdog(seconds: float, payload: dict, fallback_cpu: bool = False):
+    """If the accelerator wedges: re-exec on the CPU platform (the JSON's
+    ``platform`` field makes the substitution explicit) or, if already
+    forced, emit the failure line and hard-exit."""
 
     def fire():
+        if fallback_cpu:
+            try:
+                env = dict(os.environ)
+                env["JAX_PLATFORMS"] = "cpu"
+                env.pop("PALLAS_AXON_POOL_IPS", None)
+                args = [sys.executable, os.path.abspath(__file__),
+                        "--platform", "cpu"] + [
+                    a for a in sys.argv[1:]
+                    if not a.startswith("--platform")
+                ]
+                os.execve(sys.executable, args, env)
+            except OSError:
+                pass  # fall through: a line MUST be emitted either way
         _emit(payload)
         os._exit(2)
 
@@ -68,7 +83,8 @@ def main() -> int:
         if args.platform == "cpu":
             os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
-    watchdog = _watchdog(args.init_timeout, fail_payload)
+    watchdog = _watchdog(args.init_timeout, fail_payload,
+                         fallback_cpu=not args.platform)
     import jax
 
     if args.platform:

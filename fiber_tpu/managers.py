@@ -301,6 +301,33 @@ class LockProxy(MakeProxyType("_LockProxyBase", _LOCK_METHODS)):
 
 
 SemaphoreProxy = LockProxy  # same surface: acquire/release + `with`
+
+
+class ConditionProxy(MakeProxyType(
+        "_ConditionProxyBase",
+        ("acquire", "release", "wait", "notify", "notify_all"),
+        base=LockProxy)):
+    # wait() must not wedge notify() callers: per-thread conns inherited
+    # from LockProxy, along with the context-manager protocol.
+
+    def wait_for(self, predicate, timeout: Optional[float] = None) -> bool:
+        """Client-side wait_for: the predicate runs HERE (it usually reads
+        client-visible state), looping over remote wait()s — shipping it
+        to the server would evaluate it in the wrong process (and most
+        predicates don't pickle anyway)."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        result = predicate()
+        while not result:
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+            self.wait(remaining)
+            result = predicate()
+        return bool(result)
 _ValueProxyBase = MakeProxyType("_ValueProxyBase", ("get", "set"))
 ArrayProxy = MakeProxyType("ArrayProxy", (
     "__getitem__", "__setitem__", "__len__",
@@ -533,6 +560,7 @@ SyncManager.register("Semaphore", threading.Semaphore, SemaphoreProxy)
 SyncManager.register("BoundedSemaphore", threading.BoundedSemaphore,
                      SemaphoreProxy)
 SyncManager.register("Barrier", threading.Barrier, BarrierProxy)
+SyncManager.register("Condition", threading.Condition, ConditionProxy)
 SyncManager.register("list", list, ListProxyIter)
 SyncManager.register("dict", dict, DictProxyIter)
 SyncManager.register("Namespace", Namespace, NamespaceProxy)
@@ -553,10 +581,11 @@ def _register_async(typeid: str, factory: Callable,
 
 
 for _tid, (_fac, _proxy) in list(SyncManager._registry.items()):
-    if _tid == "RLock":
-        # Async RLock is unsound: overlapping calls ride different pooled
-        # connections (different server threads), so ownership/reentrancy
-        # can't be honored. Use the sync manager for locks.
+    if _tid in ("RLock", "Condition"):
+        # Unsound async: overlapping calls ride different pooled
+        # connections (different server threads), so thread ownership
+        # (RLock reentrancy, Condition's held-lock requirement) can't be
+        # honored. Use the sync manager for these.
         continue
     _register_async(_tid, _fac, _proxy)
 

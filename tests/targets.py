@@ -237,3 +237,10 @@ def barrier_then_report(barrier, q, tag):
     t0 = time.time()
     barrier.wait()
     q.put((tag, time.time() - t0))
+
+
+def condition_consumer(cond, ns, out_q):
+    with cond:
+        while not ns.ready:
+            cond.wait(30)
+    out_q.put("saw ready")

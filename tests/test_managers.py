@@ -226,3 +226,27 @@ def test_manager_rlock_and_cross_thread_release():
             pass
     finally:
         manager.shutdown()
+
+
+def test_manager_condition():
+    """Condition across processes: consumer parks in wait() until the
+    producer notifies under the lock."""
+    manager = fiber_tpu.Manager()
+    try:
+        cond = manager.Condition()
+        ns = manager.Namespace()
+        ns.ready = False
+        out = fiber_tpu.SimpleQueue()
+        p = fiber_tpu.Process(target=targets.condition_consumer,
+                              args=(cond, ns, out))
+        p.start()
+        time.sleep(1.0)
+        assert out.empty()       # still parked
+        with cond:
+            ns.ready = True
+            cond.notify_all()
+        assert out.get(30) == "saw ready"
+        p.join(30)
+        assert p.exitcode == 0
+    finally:
+        manager.shutdown()

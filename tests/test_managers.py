@@ -250,3 +250,30 @@ def test_manager_condition():
         assert p.exitcode == 0
     finally:
         manager.shutdown()
+
+
+def test_condition_wait_for_runs_predicate_client_side():
+    manager = fiber_tpu.Manager()
+    try:
+        cond = manager.Condition()
+        state = {"ready": False}  # CLIENT-side state: never pickled
+
+        import threading
+
+        def flip():
+            time.sleep(0.5)
+            with cond:
+                state["ready"] = True
+                cond.notify_all()
+
+        t = threading.Thread(target=flip)
+        t.start()
+        with cond:
+            ok = cond.wait_for(lambda: state["ready"], timeout=10)
+        t.join(10)
+        assert ok is True
+
+        with cond:
+            assert cond.wait_for(lambda: False, timeout=0.3) is False
+    finally:
+        manager.shutdown()

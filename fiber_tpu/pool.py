@@ -257,6 +257,31 @@ class AsyncResult:
 MapResult = AsyncResult
 
 
+class _CompletedResult:
+    """AsyncResult-compatible wrapper for work that already finished
+    (device-path dispatch completes synchronously on the mesh). Holds
+    either values or the error the dispatch raised."""
+
+    def __init__(self, values: Optional[List[Any]] = None,
+                 error: Optional[BaseException] = None) -> None:
+        self._values = values
+        self._error = error
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        if self._error is not None:
+            raise self._error
+        return list(self._values)  # fresh list per call (host semantics)
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        pass
+
+    def ready(self) -> bool:
+        return True
+
+    def successful(self) -> bool:
+        return self._error is None
+
+
 class _ResultIterator:
     """imap iterator: an item whose task raised re-raises RemoteError at
     consumption, and the iterator remains usable for the items after it
@@ -702,10 +727,21 @@ class Pool:
         """Run a @meta(device=True) function on the mesh; None if the
         function isn't device-hinted. Enforces the same pool-state
         contract as the host path."""
-        if not get_meta(func).get("device"):
+        if not self._wants_device(func):
             return None
+        return self._run_device(func, items, star)
+
+    def _wants_device(self, func: Callable) -> bool:
+        """Pool-state check happens here so state errors always surface at
+        the submit site, distinct from errors the user function raises."""
+        if not get_meta(func).get("device"):
+            return False
         if self._closed or self._terminated:
             raise ValueError("Pool not running")
+        return True
+
+    def _run_device(self, func: Callable, items: List[Any],
+                    star: bool) -> List[Any]:
         try:
             from fiber_tpu.parallel import device_map
         except ImportError as err:  # pragma: no cover
@@ -715,17 +751,32 @@ class Pool:
             ) from err
         return device_map(func, items, star=star)
 
+    def _dispatch_async(self, func, items, star, chunksize,
+                        callback, error_callback):
+        """Device-or-host submission shared by every map variant, with
+        async error contracts preserved on the device path (user-function
+        errors reach error_callback / .get(); only pool-state errors
+        surface at the submit site, like the host path)."""
+        if not self._wants_device(func):
+            return self._submit(func, items, chunksize, star,
+                                callback, error_callback)
+        try:
+            device_out = self._run_device(func, items, star)
+        except Exception as err:
+            if error_callback is not None:
+                error_callback(err)
+            return _CompletedResult(error=err)
+        if callback is not None:
+            callback(list(device_out))
+        return _CompletedResult(device_out)
+
     def map(
         self,
         func: Callable,
         iterable: Iterable[Any],
         chunksize: Optional[int] = None,
     ) -> List[Any]:
-        items = list(iterable)
-        device_out = self._device_dispatch(func, items, star=False)
-        if device_out is not None:
-            return device_out
-        return self.map_async(func, items, chunksize).get()
+        return self.map_async(func, iterable, chunksize).get()
 
     def map_async(
         self,
@@ -734,9 +785,9 @@ class Pool:
         chunksize: Optional[int] = None,
         callback: Optional[Callable] = None,
         error_callback: Optional[Callable] = None,
-    ) -> AsyncResult:
-        return self._submit(func, iterable, chunksize, False,
-                            callback, error_callback)
+    ):
+        return self._dispatch_async(func, list(iterable), False, chunksize,
+                                    callback, error_callback)
 
     def starmap(
         self,
@@ -744,11 +795,7 @@ class Pool:
         iterable: Iterable[Tuple],
         chunksize: Optional[int] = None,
     ) -> List[Any]:
-        items = [tuple(t) for t in iterable]
-        device_out = self._device_dispatch(func, items, star=True)
-        if device_out is not None:
-            return device_out
-        return self.starmap_async(func, items, chunksize).get()
+        return self.starmap_async(func, iterable, chunksize).get()
 
     def starmap_async(
         self,
@@ -757,17 +804,22 @@ class Pool:
         chunksize: Optional[int] = None,
         callback: Optional[Callable] = None,
         error_callback: Optional[Callable] = None,
-    ) -> AsyncResult:
-        return self._submit(func, [tuple(t) for t in iterable], chunksize,
-                            True, callback, error_callback)
+    ):
+        return self._dispatch_async(func, [tuple(t) for t in iterable],
+                                    True, chunksize, callback,
+                                    error_callback)
 
     def imap(
         self,
         func: Callable,
         iterable: Iterable[Any],
         chunksize: Optional[int] = None,
-    ) -> "_ResultIterator":
-        res = self._submit(func, iterable, chunksize, False)
+    ):
+        items = list(iterable)
+        device_out = self._device_dispatch(func, items, star=False)
+        if device_out is not None:
+            return iter(device_out)
+        res = self._submit(func, items, chunksize, False)
         return _ResultIterator(self._store.iter_ordered(res._seq))
 
     def imap_unordered(
@@ -775,8 +827,12 @@ class Pool:
         func: Callable,
         iterable: Iterable[Any],
         chunksize: Optional[int] = None,
-    ) -> "_ResultIterator":
-        res = self._submit(func, iterable, chunksize, False)
+    ):
+        items = list(iterable)
+        device_out = self._device_dispatch(func, items, star=False)
+        if device_out is not None:
+            return iter(device_out)
+        res = self._submit(func, items, chunksize, False)
         return _ResultIterator(self._store.iter_unordered(res._seq))
 
     # -- lifecycle ---------------------------------------------------------

@@ -344,3 +344,27 @@ def test_es_adam_optimizer():
     es.step(params, jax.random.PRNGKey(1))
     with pytest.raises(ValueError):
         es._ensure_opt_state(jnp.zeros((3,)))
+
+
+def test_async_and_imap_device_routing():
+    """All Pool map variants route @meta(device=True) functions on-mesh."""
+    from fiber_tpu.meta import meta
+
+    @meta(device=True)
+    def sq(x):
+        return x * x
+
+    with fiber_tpu.Pool(2) as pool:
+        res = pool.map_async(sq, np.arange(8.0))
+        assert res.ready() and res.successful()
+        assert [float(v) for v in res.get(1)] == [i * i for i in range(8)]
+        hits = []
+        pool.map_async(sq, np.arange(4.0), callback=hits.append)
+        assert len(hits) == 1
+        assert [float(v) for v in pool.imap(sq, np.arange(6.0))] == [
+            i * i for i in range(6)
+        ]
+        got = sorted(float(v) for v in pool.imap_unordered(
+            sq, np.arange(6.0)))
+        assert got == sorted(i * i for i in range(6))
+    assert fiber_tpu.active_children() == []

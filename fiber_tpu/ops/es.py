@@ -73,6 +73,7 @@ class EvolutionStrategy:
         quantum = 2 * self.n_dev
         self.pop_size = max(quantum, (pop_size // quantum) * quantum)
         self.pairs_per_dev = self.pop_size // quantum
+        self._fused_cache: dict = {}
         # Pallas fused-noise path: regenerate eps instead of storing it
         # (fiber_tpu/ops/pallas_es.py). "auto" engages it only on TPU and
         # only after a runtime noise-quality self-check.
@@ -172,6 +173,7 @@ class EvolutionStrategy:
             ])
             return new_params, m_new, v_new, t_new, stats
 
+        self._device_step_fn = device_step  # reused by the fused runner
         stepped = shard_map(
             device_step,
             mesh=self.mesh,
@@ -180,6 +182,52 @@ class EvolutionStrategy:
             check_vma=False,
         )
         return jax.jit(stepped)
+
+    def _build_fused(self, generations: int):
+        """N generations as ONE program: a lax.scan over the device step
+        inside shard_map — per-generation dispatch overhead disappears
+        (it dominates small-population steps on real accelerators)."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+
+        fn = self._fused_cache.get(generations)
+        if fn is not None:
+            return fn
+        device_step = self._device_step_fn
+
+        def device_run(params, m, v, t, key):
+            def body(carry, _):
+                params, m, v, t, key = carry
+                key, sub = jax.random.split(key)
+                params, m, v, t, stats = device_step(params, m, v, t, sub)
+                return (params, m, v, t, key), stats
+
+            (params, m, v, t, _), stats_seq = jax.lax.scan(
+                body, (params, m, v, t, key), None, length=generations
+            )
+            return params, m, v, t, stats_seq
+
+        fn = jax.jit(shard_map(
+            device_run,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(), P(), P()),
+            out_specs=(P(), P(), P(), P(), P()),
+            check_vma=False,
+        ))
+        self._fused_cache[generations] = fn
+        return fn
+
+    def run_fused(self, params, key, generations: int):
+        """Run N generations in one XLA program. Returns
+        (params, stats_history (generations, 3)); optimizer state
+        advances exactly as with per-step run()."""
+        m, v, t = self._ensure_opt_state(params)
+        fn = self._build_fused(generations)
+        params, m, v, t, stats_seq = fn(params, m, v, t, key)
+        if self.optimizer == "adam":
+            self._opt_state = (m, v, t)
+        return params, stats_seq
 
     # ------------------------------------------------------------------
     def _ensure_opt_state(self, params):

@@ -368,3 +368,24 @@ def test_async_and_imap_device_routing():
             sq, np.arange(6.0)))
         assert got == sorted(i * i for i in range(6))
     assert fiber_tpu.active_children() == []
+
+
+def test_es_run_fused_matches_step_semantics():
+    """Fused N-generation scan: same API surface, finite stats, optimizer
+    state advances by N."""
+    import jax
+
+    policy = MLPPolicy(CartPole.obs_dim, CartPole.act_dim, hidden=(8,))
+
+    def ef(p, k):
+        return CartPole.rollout(policy.act, p, k, max_steps=60)
+
+    es = EvolutionStrategy(ef, dim=policy.dim, pop_size=16,
+                           optimizer="adam")
+    params = policy.init(jax.random.PRNGKey(0))
+    params, stats_seq = es.run_fused(params, jax.random.PRNGKey(1), 5)
+    host = np.asarray(jax.device_get(stats_seq))
+    assert host.shape == (5, 3)
+    assert np.all(np.isfinite(host))
+    assert float(jax.device_get(es._opt_state[2])) == 5.0
+    assert np.all(np.isfinite(np.asarray(jax.device_get(params))))

@@ -23,6 +23,10 @@ def main():
     parser.add_argument("--gens", type=int, default=50)
     parser.add_argument("--steps", type=int, default=500)
     parser.add_argument("--hidden", type=int, default=32)
+    parser.add_argument("--optimizer", default="adam",
+                        choices=("sgd", "adam"))
+    parser.add_argument("--fused", action="store_true",
+                        help="run generations as fused lax.scan chunks")
     args = parser.parse_args()
 
     import jax
@@ -38,13 +42,27 @@ def main():
                                 max_steps=args.steps)
 
     es = EvolutionStrategy(eval_fn, dim=policy.dim, pop_size=args.pop,
-                           sigma=0.1, lr=0.03)
+                           sigma=0.1, lr=0.03, optimizer=args.optimizer)
     params = policy.init(jax.random.PRNGKey(0))
     key = jax.random.PRNGKey(1)
 
     t0 = time.time()
-    params, history = es.run(params, key, generations=args.gens,
-                             log_every=max(1, args.gens // 10))
+    if args.fused:
+        # Chunks of generations compile into single XLA programs; one
+        # log line per chunk.
+        chunk = max(1, args.gens // 10)
+        history = []
+        done = 0
+        while done < args.gens:
+            n = min(chunk, args.gens - done)
+            key, k = jax.random.split(key)
+            params, stats_seq = es.run_fused(params, k, n)
+            last = jax.device_get(stats_seq)[-1]
+            done += n
+            history.append((done - 1, float(last[0]), float(last[1])))
+    else:
+        params, history = es.run(params, key, generations=args.gens,
+                                 log_every=max(1, args.gens // 10))
     elapsed = time.time() - t0
 
     for gen, mean, best in history:

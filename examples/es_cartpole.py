@@ -23,7 +23,7 @@ def main():
     parser.add_argument("--gens", type=int, default=50)
     parser.add_argument("--steps", type=int, default=500)
     parser.add_argument("--hidden", type=int, default=32)
-    parser.add_argument("--optimizer", default="adam",
+    parser.add_argument("--optimizer", default="sgd",
                         choices=("sgd", "adam"))
     parser.add_argument("--fused", action="store_true",
                         help="run generations as fused lax.scan chunks")

@@ -255,6 +255,15 @@ class TpuBackend(Backend):
     def child_config(self) -> Dict[str, str]:
         return {"tpu_hosts": self._resolved_hosts_spec(), "backend": "tpu"}
 
+    def default_pool_size(self) -> int:
+        # Pool treats `processes` as the TOTAL sub-worker count and packs
+        # cpu_per_job of them per spawned job — so the natural default is
+        # one job per host × its packing factor (fills every host).
+        from fiber_tpu import config
+
+        cpu_per_job = max(1, int(config.get().cpu_per_job))
+        return len(self._hosts) * cpu_per_job
+
     def get_listen_addr(self) -> Tuple[str, int, str]:
         if all(h[0] in ("127.0.0.1", "localhost") for h in self._hosts):
             return ("127.0.0.1", 0, "lo")

@@ -124,3 +124,12 @@ class Backend:
         """Config-key overrides shipped to children in the preparation
         data, merged over the parent's resolved config."""
         return {}
+
+    def default_pool_size(self) -> int:
+        """Natural Pool(None) size for this substrate. Local: CPU count;
+        multi-host backends: one worker per host (SURVEY.md §2 packing:
+        one framework process per TPU-VM host drives that host's
+        devices; cpu_per_job then packs sub-workers within it)."""
+        import os
+
+        return os.cpu_count() or 4

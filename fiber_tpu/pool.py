@@ -445,7 +445,7 @@ class Pool:
 
         cfg = config.get()
         if processes is None:
-            processes = os.cpu_count() or 4
+            processes = get_backend().default_pool_size()
         if processes < 1:
             raise ValueError("processes must be >= 1")
         self._n_workers = processes

@@ -124,3 +124,16 @@ def test_pool_over_sim_cluster(monkeypatch):
             pass
         config.get().update(tpu_hosts=old)
         reset_backends()
+
+
+def test_default_pool_size_fills_hosts(sim_backend):
+    from fiber_tpu import config
+
+    assert sim_backend.default_pool_size() == 2  # cpu_per_job=1
+    old = config.get().cpu_per_job
+    config.get().update(cpu_per_job=4)
+    try:
+        # one job per host x 4 packed sub-workers = every host busy
+        assert sim_backend.default_pool_size() == 8
+    finally:
+        config.get().update(cpu_per_job=old)

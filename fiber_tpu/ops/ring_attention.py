@@ -128,7 +128,9 @@ def ring_attention(
     from fiber_tpu.parallel.mesh import default_mesh
 
     mesh = mesh or default_mesh()
-    key = (id(mesh), axis, causal)
+    # Mesh hashes by value (devices + axis names): no id-aliasing after GC,
+    # and equal meshes share the compiled program.
+    key = (mesh, axis, causal)
     fn = _compiled_cache.get(key)
     if fn is None:
         fn = _build_ring_attention(mesh, axis, causal)

@@ -17,9 +17,18 @@ of uniform shape. Mark them ``@fiber_tpu.meta(device=True)`` to make
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from typing import Any, Callable, Iterable, List, Optional
 
-_compile_cache: dict = {}
+# (fn, mesh, multi_arg) -> compiled, keyed on the function OBJECT, not
+# ``id(fn)``: an id can be reused after GC and silently serve a stale
+# program (round-1 VERDICT #7). A weak key can't work either — the
+# compiled closure (the value) strongly holds fn, so the entry would
+# never die. Strong keys pin fn alive, which makes aliasing impossible;
+# LRU eviction bounds the growth that pinning would otherwise leak.
+# Meshes hash by value (devices + axis names), so equal meshes share.
+_CACHE_MAX = 128
+_compile_cache: "OrderedDict" = OrderedDict()
 _cache_lock = threading.Lock()
 
 
@@ -37,11 +46,17 @@ def _compiled_mapper(fn: Callable, mesh, multi_arg: bool):
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
-    key = (id(fn), id(mesh), multi_arg)
-    with _cache_lock:
-        cached = _compile_cache.get(key)
-        if cached is not None:
-            return cached
+    try:
+        hash(fn)
+        key = (fn, mesh, multi_arg)
+    except TypeError:
+        key = None  # unhashable callable: compile uncached
+    if key is not None:
+        with _cache_lock:
+            cached = _compile_cache.get(key)
+            if cached is not None:
+                _compile_cache.move_to_end(key)
+                return cached
 
     if multi_arg:
         def per_item(packed):
@@ -60,8 +75,11 @@ def _compiled_mapper(fn: Callable, mesh, multi_arg: bool):
         return mapped(batched)
 
     compiled = jax.jit(run)
-    with _cache_lock:
-        _compile_cache[key] = compiled
+    if key is not None:
+        with _cache_lock:
+            _compile_cache[key] = compiled
+            while len(_compile_cache) > _CACHE_MAX:
+                _compile_cache.popitem(last=False)
     return compiled
 
 

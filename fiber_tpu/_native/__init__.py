@@ -98,6 +98,9 @@ def load() -> Optional[ctypes.CDLL]:
         lib.fiber_pump_create.restype = ctypes.c_void_p
         lib.fiber_pump_create.argtypes = [
             ctypes.c_int,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_int,
             ctypes.POINTER(ctypes.c_int),
             ctypes.POINTER(ctypes.c_int),
         ]
@@ -107,7 +110,8 @@ def load() -> Optional[ctypes.CDLL]:
         lib.fiber_pump_peers.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.nq_connect.restype = ctypes.c_void_p
         lib.nq_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
-                                   ctypes.c_int, ctypes.c_int]
+                                   ctypes.c_int, ctypes.c_int,
+                                   ctypes.c_char_p, ctypes.c_int]
         lib.nq_shutdown.restype = None
         lib.nq_shutdown.argtypes = [ctypes.c_void_p]
         lib.nq_send.restype = ctypes.c_int
@@ -135,14 +139,18 @@ class NativePump:
     """One native device: two bound ports + an epoll forwarder thread in
     C++. Speaks the transport wire protocol exactly."""
 
-    def __init__(self, duplex: bool) -> None:
+    def __init__(self, duplex: bool, bind_ip: str = "") -> None:
         lib = load()
         if lib is None:
             raise RuntimeError("native pump unavailable")
         in_port = ctypes.c_int(0)
         out_port = ctypes.c_int(0)
+        key = _data_plane_key()
         handle = lib.fiber_pump_create(
             1 if duplex else 0,
+            bind_ip.encode(),
+            key,
+            len(key),
             ctypes.byref(in_port),
             ctypes.byref(out_port),
         )
@@ -174,6 +182,14 @@ class NativePump:
             pass
 
 
+def _data_plane_key() -> bytes:
+    """Handshake key for the native transport (empty = auth disabled);
+    must agree with the Python endpoints' fiber_tpu.auth settings."""
+    from fiber_tpu import auth
+
+    return auth.cluster_key() if auth.auth_enabled() else b""
+
+
 def available() -> bool:
     return load() is not None
 
@@ -200,8 +216,9 @@ class NativeClient:
         code = _MODE_CODES.get(mode)
         if code is None:
             raise ValueError(f"native client does not support mode {mode!r}")
+        key = _data_plane_key()
         handle = lib.nq_connect(host.encode(), port, code,
-                                self.CONNECT_TIMEOUT_MS)
+                                self.CONNECT_TIMEOUT_MS, key, len(key))
         if not handle:
             raise OSError(f"nq_connect failed for {host}:{port}")
         self._lib = lib

@@ -22,6 +22,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/epoll.h>
+#include <sys/random.h>
 #include <sys/socket.h>
 #include <thread>
 #include <unistd.h>
@@ -33,6 +34,7 @@ namespace {
 constexpr uint64_t kCreditWindow = 4096;  // matches transport/tcp.py
 constexpr uint8_t kData = 0x00;
 constexpr uint8_t kCredit = 0x01;
+constexpr uint8_t kAuth = 0x02;  // handshake frames (fiber_tpu/auth.py)
 constexpr size_t kReadChunk = 1 << 16;
 // Frame ceiling (matches framing.py MAX_FRAME): bounds `8 + flen`
 // arithmetic and rejects corrupted/hostile length headers.
@@ -53,6 +55,128 @@ uint32_t be32(const uint8_t* p) {
          (uint32_t(p[2]) << 8) | uint32_t(p[3]);
 }
 
+// ── SHA-256 (FIPS 180-4) + HMAC (RFC 2104) for the data-plane handshake.
+// Messages are tiny (≤ 52 bytes), so a one-shot implementation suffices.
+
+constexpr uint32_t kShaK[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+inline uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+void sha256(const uint8_t* data, size_t len, uint8_t out[32]) {
+  uint32_t h[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                   0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  // padded message: data || 0x80 || zeros || 64-bit bit-length
+  size_t padded = ((len + 8) / 64 + 1) * 64;
+  std::vector<uint8_t> m(padded, 0);
+  memcpy(m.data(), data, len);
+  m[len] = 0x80;
+  uint64_t bits = uint64_t(len) * 8;
+  for (int i = 0; i < 8; i++) m[padded - 1 - i] = (bits >> (8 * i)) & 0xff;
+  for (size_t off = 0; off < padded; off += 64) {
+    uint32_t w[64];
+    for (int t = 0; t < 16; t++) {
+      const uint8_t* p = m.data() + off + 4 * t;
+      w[t] = (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+             (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+    }
+    for (int t = 16; t < 64; t++) {
+      uint32_t s0 = rotr(w[t - 15], 7) ^ rotr(w[t - 15], 18) ^ (w[t - 15] >> 3);
+      uint32_t s1 = rotr(w[t - 2], 17) ^ rotr(w[t - 2], 19) ^ (w[t - 2] >> 10);
+      w[t] = w[t - 16] + s0 + w[t - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3];
+    uint32_t e = h[4], f = h[5], g = h[6], hh = h[7];
+    for (int t = 0; t < 64; t++) {
+      uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + kShaK[t] + w[t];
+      uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+  for (int i = 0; i < 8; i++) {
+    out[4 * i] = (h[i] >> 24) & 0xff;
+    out[4 * i + 1] = (h[i] >> 16) & 0xff;
+    out[4 * i + 2] = (h[i] >> 8) & 0xff;
+    out[4 * i + 3] = h[i] & 0xff;
+  }
+}
+
+void hmac_sha256(const uint8_t* key, size_t keylen, const uint8_t* msg,
+                 size_t msglen, uint8_t out[32]) {
+  uint8_t k[64] = {0};
+  if (keylen > 64) {
+    sha256(key, keylen, k);  // hashed key occupies the first 32 bytes
+  } else {
+    memcpy(k, key, keylen);
+  }
+  std::vector<uint8_t> inner(64 + msglen);
+  for (int i = 0; i < 64; i++) inner[i] = k[i] ^ 0x36;
+  memcpy(inner.data() + 64, msg, msglen);
+  uint8_t inner_digest[32];
+  sha256(inner.data(), inner.size(), inner_digest);
+  uint8_t outer[64 + 32];
+  for (int i = 0; i < 64; i++) outer[i] = k[i] ^ 0x5c;
+  memcpy(outer + 64, inner_digest, 32);
+  sha256(outer, sizeof outer, out);
+}
+
+constexpr size_t kNonceLen = 16;
+constexpr size_t kDigestLen = 32;
+
+// HMAC over tag(4) || nonce(16) — the protocol of fiber_tpu/auth.py.
+void auth_mac(const std::vector<uint8_t>& key, const char tag[4],
+              const uint8_t* nonce, uint8_t out[32]) {
+  uint8_t msg[4 + kNonceLen];
+  memcpy(msg, tag, 4);
+  memcpy(msg + 4, nonce, kNonceLen);
+  hmac_sha256(key.data(), key.size(), msg, sizeof msg, out);
+}
+
+bool ct_equal(const uint8_t* a, const uint8_t* b, size_t n) {
+  uint8_t acc = 0;
+  for (size_t i = 0; i < n; i++) acc |= a[i] ^ b[i];
+  return acc == 0;
+}
+
+void fill_random(uint8_t* p, size_t n) {
+  if (getrandom(p, n, 0) == ssize_t(n)) return;
+  int fd = ::open("/dev/urandom", O_RDONLY);
+  if (fd >= 0) {
+    size_t off = 0;
+    while (off < n) {
+      ssize_t got = ::read(fd, p + off, n - off);
+      if (got <= 0) break;
+      off += size_t(got);
+    }
+    ::close(fd);
+  }
+}
+
+std::vector<uint8_t> auth_frame(const uint8_t* body, size_t n) {
+  std::vector<uint8_t> f(8 + 1 + n);
+  put_be64(f.data(), 1 + n);
+  f[8] = kAuth;
+  memcpy(f.data() + 9, body, n);
+  return f;
+}
+
 std::vector<uint8_t> credit_frame(uint32_t n) {
   std::vector<uint8_t> f(8 + 1 + 4);
   put_be64(f.data(), 5);
@@ -66,6 +190,8 @@ struct Conn {
   int fd = -1;
   uint64_t id = 0;               // generation id: never reused, unlike fds
   bool in_side = false;          // accepted on the in-listener
+  bool authed = false;           // handshake complete (always true w/o key)
+  uint8_t nonce[kNonceLen] = {}; // server challenge sent to this peer
   // read state machine
   std::vector<uint8_t> rbuf;
   size_t rpos = 0;               // consumed offset into rbuf
@@ -95,10 +221,13 @@ struct Device {
   size_t rr_fwd = 0, rr_rev = 0;
   std::atomic<bool> stop{false};
   std::atomic<int> n_in{0}, n_out{0};
+  std::vector<uint8_t> key;  // empty = handshake disabled
   std::thread thr;
 };
 
-int make_listener(int* port_out) {
+// bind_ip empty/null = INADDR_ANY; otherwise the specific interface (the
+// data plane must not ride every NIC for loopback-only backends).
+int make_listener(const char* bind_ip, int* port_out) {
   int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   if (fd < 0) return -1;
   int one = 1;
@@ -106,6 +235,12 @@ int make_listener(int* port_out) {
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (bind_ip != nullptr && bind_ip[0] != '\0') {
+    if (inet_pton(AF_INET, bind_ip, &addr.sin_addr) != 1) {
+      ::close(fd);
+      return -1;
+    }
+  }
   addr.sin_port = 0;
   if (bind(fd, (sockaddr*)&addr, sizeof addr) < 0 || listen(fd, 512) < 0) {
     ::close(fd);
@@ -173,8 +308,40 @@ void pump_all(Device* d) {
   }
 }
 
+// Auth complete: the peer becomes a forwarding target and (producers)
+// receives its standing credit window.
+void promote_conn(Device* d, Conn* c) {
+  c->authed = true;
+  (c->in_side ? d->in_fds : d->out_fds).push_back(c->fd);
+  (c->in_side ? d->n_in : d->n_out).fetch_add(1);
+  if (c->in_side && !d->duplex) {
+    queue_write(d, c, credit_frame(uint32_t(kCreditWindow)));
+  }
+}
+
 void handle_frame(Device* d, Conn* c, const uint8_t* body, uint64_t blen,
                   const uint8_t* wire, uint64_t wlen) {
+  if (!c->authed) {
+    // First frame must be the handshake response: Nc(16) + HMAC(key,
+    // "FTC0" || Ns)(32). Anything else — including data/credit frames
+    // from an unauthenticated peer — kills the connection.
+    if (blen != 1 + kNonceLen + kDigestLen || body[0] != kAuth) {
+      drop_conn(d, c->fd);
+      return;
+    }
+    uint8_t expect[kDigestLen];
+    auth_mac(d->key, "FTC0", c->nonce, expect);
+    if (!ct_equal(body + 1 + kNonceLen, expect, kDigestLen)) {
+      drop_conn(d, c->fd);
+      return;
+    }
+    uint8_t answer[kDigestLen];
+    auth_mac(d->key, "FTS0", body + 1, answer);
+    queue_write(d, c, auth_frame(answer, kDigestLen));
+    promote_conn(d, c);
+    pump_all(d);
+    return;
+  }
   if (blen >= 1 && body[0] == kCredit) {
     if (blen >= 5) c->credit += be32(body + 1);
     pump_all(d);
@@ -212,6 +379,7 @@ void on_readable(Device* d, Conn* c) {
   }
   // parse complete frames
   size_t pos = c->rpos;
+  const uint64_t cid = c->id;  // survives drop_conn; fds/pointers don't
   for (;;) {
     if (c->rbuf.size() - pos < 8) break;
     uint64_t flen = be64(c->rbuf.data() + pos);
@@ -222,9 +390,10 @@ void on_readable(Device* d, Conn* c) {
     if (c->rbuf.size() - pos < 8 + flen) break;
     handle_frame(d, c, c->rbuf.data() + pos + 8, flen,
                  c->rbuf.data() + pos, 8 + flen);
+    // handle_frame may have dropped (and freed) c — e.g. a failed auth
+    // response. The generation id is the only safe way to find out.
+    if (d->conns_by_id.find(cid) == d->conns_by_id.end()) return;
     pos += 8 + flen;
-    // c may have been dropped by handle_frame side effects
-    if (d->conns.find(c->fd) == d->conns.end()) return;
   }
   c->rpos = pos;
   if (c->rpos > (1 << 20) || c->rpos == c->rbuf.size()) {
@@ -268,7 +437,7 @@ void drop_conn(Device* d, int fd) {
   };
   scrub(d->in_fds);
   scrub(d->out_fds);
-  (c->in_side ? d->n_in : d->n_out).fetch_sub(1);
+  if (c->authed) (c->in_side ? d->n_in : d->n_out).fetch_sub(1);
   delete c;
 }
 
@@ -284,15 +453,17 @@ void on_accept(Device* d, int listen_fd, bool in_side) {
     c->in_side = in_side;
     d->conns[fd] = c;
     d->conns_by_id[c->id] = c;
-    (in_side ? d->in_fds : d->out_fds).push_back(fd);
-    (in_side ? d->n_in : d->n_out).fetch_add(1);
     epoll_event ev{};
     ev.data.fd = fd;
     ev.events = EPOLLIN;
     epoll_ctl(d->epfd, EPOLL_CTL_ADD, fd, &ev);
-    if (in_side && !d->duplex) {
-      // producers get a standing credit window (bound r-endpoint role)
-      queue_write(d, c, credit_frame(uint32_t(kCreditWindow)));
+    if (d->key.empty()) {
+      promote_conn(d, c);
+    } else {
+      // challenge first; the peer joins the forwarding lists only after
+      // handle_frame verifies its response
+      fill_random(c->nonce, kNonceLen);
+      queue_write(d, c, auth_frame(c->nonce, kNonceLen));
     }
     pump_all(d);
   }
@@ -333,12 +504,16 @@ extern "C" {
 // Returns an opaque handle, or nullptr. in_port/out_port receive the bound
 // ports. duplex=0: queue device (in "r" bound <- producers; out "w" bound
 // -> consumers, credit-gated). duplex=1: pipe relay, both sides rw.
-void* fiber_pump_create(int duplex, int* in_port, int* out_port) {
+// key/key_len: the data-plane handshake secret; key_len=0 disables auth.
+// bind_ip: interface to listen on (empty = all).
+void* fiber_pump_create(int duplex, const char* bind_ip, const uint8_t* key,
+                        int key_len, int* in_port, int* out_port) {
   Device* d = new Device();
   d->duplex = duplex != 0;
+  if (key != nullptr && key_len > 0) d->key.assign(key, key + key_len);
   d->epfd = epoll_create1(0);
-  d->in_listen = make_listener(in_port);
-  d->out_listen = make_listener(out_port);
+  d->in_listen = make_listener(bind_ip, in_port);
+  d->out_listen = make_listener(bind_ip, out_port);
   int pipefd[2];
   if (d->epfd < 0 || d->in_listen < 0 || d->out_listen < 0 ||
       pipe2(pipefd, O_NONBLOCK) < 0) {
@@ -489,7 +664,8 @@ int client_read_frame(Client* c, int timeout_ms, uint8_t* type_out,
 
 extern "C" {
 
-void* nq_connect(const char* host, int port, int mode, int timeout_ms) {
+void* nq_connect(const char* host, int port, int mode, int timeout_ms,
+                 const uint8_t* key, int key_len) {
   int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   if (fd < 0) return nullptr;
   sockaddr_in addr{};
@@ -525,6 +701,45 @@ void* nq_connect(const char* host, int port, int mode, int timeout_ms) {
   Client* c = new Client();
   c->fd = fd;
   c->mode = mode;
+  if (key != nullptr && key_len > 0) {
+    // Dialer role of the handshake (fiber_tpu/auth.py): read challenge,
+    // answer with our nonce + client MAC, verify the server's MAC.
+    uint8_t type = 0;
+    uint8_t* body = nullptr;
+    uint64_t blen = 0;
+    int rc = client_read_frame(c, timeout_ms, &type, &body, &blen);
+    if (rc != 1 || type != kAuth || blen != kNonceLen) {
+      if (rc == 1) free(body);
+      ::close(fd);
+      delete c;
+      return nullptr;
+    }
+    std::vector<uint8_t> keyv(key, key + key_len);
+    uint8_t nc_nonce[kNonceLen];
+    fill_random(nc_nonce, kNonceLen);
+    uint8_t resp[kNonceLen + kDigestLen];
+    memcpy(resp, nc_nonce, kNonceLen);
+    auth_mac(keyv, "FTC0", body, resp + kNonceLen);
+    free(body);
+    if (!client_send_frame(c, resp, sizeof resp, kAuth)) {
+      ::close(fd);
+      delete c;
+      return nullptr;
+    }
+    rc = client_read_frame(c, timeout_ms, &type, &body, &blen);
+    bool ok = rc == 1 && type == kAuth && blen == kDigestLen;
+    if (ok) {
+      uint8_t expect[kDigestLen];
+      auth_mac(keyv, "FTS0", nc_nonce, expect);
+      ok = ct_equal(body, expect, kDigestLen);
+    }
+    if (rc == 1) free(body);
+    if (!ok) {
+      ::close(fd);
+      delete c;
+      return nullptr;
+    }
+  }
   return c;
 }
 

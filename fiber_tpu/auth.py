@@ -1,0 +1,108 @@
+"""Data-plane authentication: a mutual HMAC-SHA256 challenge/response run
+once per TCP connection, before any data or credit frames.
+
+The host data plane (pool task/result streams, queue devices) carries
+pickled payloads, so an unauthenticated peer reaching a bound port would
+get arbitrary-code execution in the dialing master/worker (advisor,
+round 1 — the reference has the same exposure through nanomsg,
+fiber/socket.py, but never deploys multi-host where it bites). Every
+connection therefore proves knowledge of the shared cluster key first:
+
+1. acceptor -> dialer:  AUTH frame, 16-byte nonce ``Ns``
+2. dialer -> acceptor:  AUTH frame, 16-byte nonce ``Nc``
+                        + HMAC(key, "FTC0" || Ns)
+3. acceptor -> dialer:  AUTH frame, HMAC(key, "FTS0" || Nc)
+
+Both sides verify with a constant-time compare and close on mismatch.
+The same protocol is spoken by the Python endpoints here and the native
+C pump/client (_native/pump.cpp). ``FIBER_DATA_AUTH=0`` disables the
+handshake (both sides must agree — e.g. fully trusted localhost runs).
+
+The key is the cluster key: FIBER_CLUSTER_KEY, or a well-known default
+that is only acceptable on loopback (the host agent refuses non-loopback
+binds with the default key).
+"""
+
+from __future__ import annotations
+
+import hmac
+import hashlib
+import os
+import socket
+from typing import Optional
+
+from fiber_tpu.framing import recv_frame, send_frame
+
+#: Frame-type tag for handshake frames (data = 0x00, credit = 0x01).
+T_AUTH = b"\x02"
+
+_NONCE = 16
+_DIGEST = 32
+_CLIENT_TAG = b"FTC0"
+_SERVER_TAG = b"FTS0"
+_HANDSHAKE_TIMEOUT = 20.0
+
+DEFAULT_KEY = "fiber-tpu-cluster"
+
+
+class AuthenticationError(OSError):
+    """Peer failed the data-plane handshake."""
+
+
+def cluster_key() -> bytes:
+    """Shared secret for every authenticated plane (agents, managers, and
+    the data plane): FIBER_CLUSTER_KEY or the development default."""
+    return os.environ.get("FIBER_CLUSTER_KEY", DEFAULT_KEY).encode()
+
+
+def auth_enabled() -> bool:
+    return os.environ.get("FIBER_DATA_AUTH", "1") not in ("0", "false")
+
+
+def _mac(key: bytes, tag: bytes, nonce: bytes) -> bytes:
+    return hmac.new(key, tag + nonce, hashlib.sha256).digest()
+
+
+def _recv_auth(sock: socket.socket) -> bytes:
+    frame = recv_frame(sock)
+    if not frame or frame[:1] != T_AUTH:
+        raise AuthenticationError("expected auth frame")
+    return frame[1:]
+
+
+def server_handshake(sock: socket.socket, key: Optional[bytes] = None) -> None:
+    """Acceptor role. Raises AuthenticationError / OSError on failure; the
+    caller closes the socket."""
+    key = cluster_key() if key is None else key
+    old_timeout = sock.gettimeout()
+    sock.settimeout(_HANDSHAKE_TIMEOUT)
+    try:
+        ns = os.urandom(_NONCE)
+        send_frame(sock, ns, prefix=T_AUTH)
+        reply = _recv_auth(sock)
+        if len(reply) != _NONCE + _DIGEST:
+            raise AuthenticationError("malformed auth response")
+        nc, digest = reply[:_NONCE], reply[_NONCE:]
+        if not hmac.compare_digest(digest, _mac(key, _CLIENT_TAG, ns)):
+            raise AuthenticationError("peer failed data-plane auth")
+        send_frame(sock, _mac(key, _SERVER_TAG, nc), prefix=T_AUTH)
+    finally:
+        sock.settimeout(old_timeout)
+
+
+def client_handshake(sock: socket.socket, key: Optional[bytes] = None) -> None:
+    """Dialer role. Raises AuthenticationError / OSError on failure."""
+    key = cluster_key() if key is None else key
+    old_timeout = sock.gettimeout()
+    sock.settimeout(_HANDSHAKE_TIMEOUT)
+    try:
+        ns = _recv_auth(sock)
+        if len(ns) != _NONCE:
+            raise AuthenticationError("malformed auth challenge")
+        nc = os.urandom(_NONCE)
+        send_frame(sock, nc + _mac(key, _CLIENT_TAG, ns), prefix=T_AUTH)
+        answer = _recv_auth(sock)
+        if not hmac.compare_digest(answer, _mac(key, _SERVER_TAG, nc)):
+            raise AuthenticationError("server failed data-plane auth")
+    finally:
+        sock.settimeout(old_timeout)

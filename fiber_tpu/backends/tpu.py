@@ -138,6 +138,12 @@ class TpuBackend(Backend):
         # Registered before any spawn so a partial startup failure still
         # reaps the agents that did come up.
         atexit.register(self.shutdown_sim_cluster)
+        from fiber_tpu.utils.misc import package_pythonpath
+
+        # Agents must import fiber_tpu no matter where the user's script
+        # runs from (a bare `-m fiber_tpu.host_agent` only works when cwd
+        # happens to contain the package).
+        env = dict(os.environ, PYTHONPATH=package_pythonpath())
         hosts = []
         for _ in range(n):
             proc = subprocess.Popen(
@@ -146,6 +152,7 @@ class TpuBackend(Backend):
                 stdout=subprocess.PIPE,
                 stderr=subprocess.DEVNULL,
                 text=True,
+                env=env,
             )
             self._sim_agents.append(proc)
             line = proc.stdout.readline().strip()

@@ -76,24 +76,37 @@ def cmd_agent(args) -> int:
     argv = ["--port", str(args.port), "--bind", args.bind]
     if args.announce:
         argv.append("--announce")
+    if args.unrestricted_files:
+        argv.append("--unrestricted-files")
     return host_agent.main(argv)
 
 
 def cmd_up(args) -> int:
-    """Emit (or run) agent-start commands for every pod-slice host."""
+    """Emit (or run) agent-start commands for every pod-slice host.
+
+    A fresh cluster key is generated when the operator hasn't set one —
+    pod agents bind non-loopback, and the agent refuses that with the
+    well-known default key.
+    """
+    import secrets
+
     from fiber_tpu.host_agent import DEFAULT_AGENT_PORT
 
     port = args.port or DEFAULT_AGENT_PORT
-    key_prefix = ""
-    if os.environ.get("FIBER_CLUSTER_KEY"):
-        # Agents must share the operator's cluster key or every later
-        # master/status/cp call fails HMAC auth.
-        key_prefix = (
-            f"FIBER_CLUSTER_KEY={shlex.quote(os.environ['FIBER_CLUSTER_KEY'])} "
+    key = os.environ.get("FIBER_CLUSTER_KEY")
+    if not key:
+        key = secrets.token_hex(32)
+        print(
+            "# generated cluster key — export it before running the "
+            f"master:\nexport FIBER_CLUSTER_KEY={key}",
+            file=sys.stderr,
         )
+    # Agents must share the operator's cluster key or every later
+    # master/status/cp call fails HMAC auth.
     agent_cmd = (
-        f"{key_prefix}nohup {args.python} -m fiber_tpu.host_agent "
-        f"--port {port} >/tmp/fiber-agent.log 2>&1 &"
+        f"FIBER_CLUSTER_KEY={shlex.quote(key)} "
+        f"nohup {args.python} -m fiber_tpu.host_agent "
+        f"--port {port} --bind 0.0.0.0 >/tmp/fiber-agent.log 2>&1 &"
     )
     if args.tpu:
         base = (
@@ -190,9 +203,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("agent", help="run the per-host agent daemon")
     p.add_argument("--port", type=int, default=7060)
-    p.add_argument("--bind", default="0.0.0.0",
-                   help="interface to bind (use 127.0.0.1 for local-only)")
+    p.add_argument("--bind", default="127.0.0.1",
+                   help="interface to bind; non-loopback requires "
+                        "FIBER_CLUSTER_KEY")
     p.add_argument("--announce", action="store_true")
+    p.add_argument("--unrestricted-files", action="store_true",
+                   help="allow put_file/get_file anywhere on disk")
     p.set_defaults(fn=cmd_agent)
 
     p = sub.add_parser("up", help="start agents on every pod-slice host")

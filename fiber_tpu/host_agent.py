@@ -32,8 +32,10 @@ DEFAULT_AGENT_PORT = 7060
 
 def cluster_authkey() -> bytes:
     """Shared-secret for agent auth: FIBER_CLUSTER_KEY env or a
-    well-known development default."""
-    return os.environ.get("FIBER_CLUSTER_KEY", "fiber-tpu-cluster").encode()
+    well-known development default (one source: fiber_tpu.auth)."""
+    from fiber_tpu.auth import cluster_key
+
+    return cluster_key()
 
 
 class _AgentJob:
@@ -46,19 +48,38 @@ class _AgentJob:
 MAX_FINISHED_JOBS = 1024
 
 
+def default_staging_root() -> str:
+    """Where file-staging ops may read/write unless ``--unrestricted-files``:
+    FIBER_AGENT_STAGING or ~/.fiber_tpu/staging."""
+    return os.environ.get(
+        "FIBER_AGENT_STAGING",
+        os.path.join(os.path.expanduser("~"), ".fiber_tpu", "staging"),
+    )
+
+
 class HostAgent:
     """Serves spawn/poll/wait/logs/signal/put_file requests."""
 
     def __init__(self, port: int, authkey: Optional[bytes] = None,
-                 bind: str = "0.0.0.0") -> None:
+                 bind: str = "127.0.0.1",
+                 staging_root: Optional[str] = None,
+                 restrict_files: bool = True) -> None:
         if (bind not in ("127.0.0.1", "localhost")
+                and authkey is None
                 and "FIBER_CLUSTER_KEY" not in os.environ):
-            print(
-                "fiber-tpu agent WARNING: binding non-loopback with the "
-                "default cluster key; set FIBER_CLUSTER_KEY on every host "
-                "(the default key is public knowledge).",
-                file=sys.stderr, flush=True,
+            # The agent is spawn-anything-as-me; with the well-known default
+            # key that is unauthenticated RCE for anyone with network reach.
+            # Refuse outright rather than warn (advisor, round 1).
+            raise RuntimeError(
+                "fiber-tpu agent: refusing to bind non-loopback interface "
+                f"{bind!r} with the default cluster key. Set "
+                "FIBER_CLUSTER_KEY (e.g. `openssl rand -hex 32`) on every "
+                "host, or bind 127.0.0.1."
             )
+        self._staging_root = os.path.realpath(
+            staging_root or default_staging_root()
+        )
+        self._restrict_files = restrict_files
         self._listener = Listener(
             (bind, port), authkey=authkey or cluster_authkey()
         )
@@ -204,9 +225,30 @@ class HostAgent:
                 if j.proc.poll() is None
             ]
 
+    def _file_path(self, path: str) -> str:
+        """Resolve a file-op path. Relative paths land under the staging
+        root; absolute paths must stay inside the staging root or the
+        system tempdir unless the agent runs ``--unrestricted-files``
+        (advisor: confine the remote read/write surface)."""
+        if not os.path.isabs(path):
+            path = os.path.join(self._staging_root, path)
+        real = os.path.realpath(path)
+        if self._restrict_files:
+            allowed = (self._staging_root,
+                       os.path.realpath(tempfile.gettempdir()))
+            if not any(real == root or real.startswith(root + os.sep)
+                       for root in allowed):
+                raise PermissionError(
+                    f"agent file ops are confined to {allowed} "
+                    f"(got {path!r}); start the agent with "
+                    "--unrestricted-files to lift this"
+                )
+        return real
+
     def _op_put_file(self, path: str, data: bytes, mode: int = 0o644) -> int:
         """File staging — the ``fiber cp`` equivalent (reference:
         fiber/cli.py:112-170 copies through a PVC pod)."""
+        path = self._file_path(path)
         directory = os.path.dirname(path)
         if directory:
             os.makedirs(directory, exist_ok=True)
@@ -216,7 +258,7 @@ class HostAgent:
         return len(data)
 
     def _op_get_file(self, path: str) -> bytes:
-        with open(path, "rb") as fh:
+        with open(self._file_path(path), "rb") as fh:
             return fh.read()
 
     def _op_host_info(self) -> dict:
@@ -225,6 +267,7 @@ class HostAgent:
             "cpu_count": os.cpu_count(),
             "cwd": os.getcwd(),
             "python": sys.executable,
+            "staging_root": self._staging_root,
         }
 
     def _op_shutdown(self) -> None:
@@ -245,12 +288,20 @@ class HostAgent:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="fiber_tpu.host_agent")
     parser.add_argument("--port", type=int, default=DEFAULT_AGENT_PORT)
-    parser.add_argument("--bind", default="0.0.0.0",
-                        help="interface to bind (sim clusters: 127.0.0.1)")
+    parser.add_argument("--bind", default="127.0.0.1",
+                        help="interface to bind; non-loopback requires "
+                             "FIBER_CLUSTER_KEY to be set")
     parser.add_argument("--announce", action="store_true",
                         help="print the bound port to stdout once serving")
+    parser.add_argument("--staging-root", default=None,
+                        help="root for put_file/get_file "
+                             "(default: ~/.fiber_tpu/staging)")
+    parser.add_argument("--unrestricted-files", action="store_true",
+                        help="allow put_file/get_file anywhere on disk")
     args = parser.parse_args(argv)
-    agent = HostAgent(args.port, bind=args.bind)
+    agent = HostAgent(args.port, bind=args.bind,
+                      staging_root=args.staging_root,
+                      restrict_files=not args.unrestricted_files)
     if args.announce:
         print(f"AGENT_PORT {agent.port}", flush=True)
     # Die with the parent where supported (sim clusters).

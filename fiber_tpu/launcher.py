@@ -132,16 +132,9 @@ class JobLauncher:
         # The worker interpreter must be able to import fiber_tpu *before*
         # the preparation frame (which carries the full sys.path) arrives,
         # so the package root rides PYTHONPATH in the job environment.
-        import fiber_tpu
+        from fiber_tpu.utils.misc import package_pythonpath
 
-        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
-            fiber_tpu.__file__)))
-        pythonpath = os.environ.get("PYTHONPATH", "")
-        if pkg_root not in pythonpath.split(os.pathsep):
-            pythonpath = (
-                pkg_root + os.pathsep + pythonpath if pythonpath else pkg_root
-            )
-        env = {"FIBER_WORKER": "1", "PYTHONPATH": pythonpath}
+        env = {"FIBER_WORKER": "1", "PYTHONPATH": package_pythonpath()}
         needs_device = bool(
             hints.get("tpu") or hints.get("gpu") or hints.get("device")
         )

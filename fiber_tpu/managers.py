@@ -69,8 +69,11 @@ class Server:
         from fiber_tpu.backends import get_backend
 
         self._registry = registry
-        self._listener = Listener(("0.0.0.0", 0), authkey=bytes(authkey))
+        # Bind only the address consumers actually dial (the backend's
+        # listen ip) — 0.0.0.0 exposed the HMAC-pickle RPC to every
+        # interface even for purely local backends (advisor, round 1).
         ip, _, _ = get_backend().get_listen_addr()
+        self._listener = Listener((ip, 0), authkey=bytes(authkey))
         self.address: Tuple[str, int] = (ip, self._listener.address[1])
         self._objects: Dict[int, Any] = {}
         self._next_ident = 0

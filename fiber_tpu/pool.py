@@ -97,6 +97,11 @@ class ResultStore:
             entry = self._entries.get(seq)
             if entry is None:
                 return
+            if base < 0 or base + len(values) > entry.total:
+                raise ValueError(
+                    f"result frame out of range: base={base} "
+                    f"n={len(values)} total={entry.total}"
+                )
             for offset, value in enumerate(values):
                 idx = base + offset
                 if entry.values[idx] is _UNSET:
@@ -642,12 +647,17 @@ class Pool:
                 data = self._result_ep.recv()
             except (TransportClosed, OSError):
                 return
-            msg = serialization.loads(data)
-            if msg[0] != "result":
-                continue
-            _, seq, base, values, ident = msg
-            self._on_result(seq, base, values, ident)
-            self._store.fill(seq, base, values)
+            # A malformed frame must not kill the loop — that silently
+            # hangs every outstanding .get() (advisor, round 1).
+            try:
+                msg = serialization.loads(data)
+                if msg[0] != "result":
+                    continue
+                _, seq, base, values, ident = msg
+                self._on_result(seq, base, values, ident)
+                self._store.fill(seq, base, values)
+            except Exception:
+                logger.exception("pool: dropping malformed result frame")
 
     def _on_result(self, seq, base, values, ident) -> None:
         pass

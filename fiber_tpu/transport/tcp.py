@@ -25,6 +25,7 @@ import struct
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from fiber_tpu import auth
 from fiber_tpu.framing import (
     ConnectionClosed,
     recv_frame,
@@ -159,6 +160,7 @@ class Endpoint:
         self._rr = 0
         self._listener: Optional[pysocket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
+        self._handshake_slots = threading.BoundedSemaphore(64)
         self._closed = False
         self._reply_to: Optional[_Channel] = None
         self.addr: Optional[str] = None
@@ -173,13 +175,26 @@ class Endpoint:
 
     # -- wiring -----------------------------------------------------------
     def bind(self, ip: str, port: int = 0) -> str:
-        """Listen and return the advertised address ``tcp://ip:port``."""
+        """Listen on ``ip`` and return the advertised address
+        ``tcp://ip:port``. The listener binds that specific interface — a
+        wildcard bind would expose the pickle-carrying data plane on every
+        NIC even for loopback-only backends. Non-loopback binds demand a
+        real cluster key (the default is public knowledge)."""
+        if (ip not in ("127.0.0.1", "localhost")
+                and auth.auth_enabled()
+                and auth.cluster_key() == auth.DEFAULT_KEY.encode()):
+            raise TransportClosed(
+                "refusing to bind the data plane on non-loopback "
+                f"{ip!r} with the default cluster key; set "
+                "FIBER_CLUSTER_KEY on every host (fiber-tpu up generates "
+                "one), or FIBER_DATA_AUTH=0 on an isolated network"
+            )
         listener = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_STREAM)
         listener.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_REUSEADDR, 1)
         if port:
-            listener.bind(("", port))
+            listener.bind((ip, port))
         else:
-            _, port = random_port_bind(listener)
+            _, port = random_port_bind(listener, host=ip)
         listener.listen(512)
         self._listener = listener
         self._is_bound = True
@@ -194,6 +209,12 @@ class Endpoint:
         host, port = parse_addr(addr)
         sock = pysocket.create_connection((host, port), timeout=30.0)
         sock.settimeout(None)
+        if auth.auth_enabled():
+            try:
+                auth.client_handshake(sock)
+            except (OSError, auth.AuthenticationError):
+                sock.close()
+                raise
         self.addr = addr
         self._add_channel(sock)
         return self
@@ -204,7 +225,38 @@ class Endpoint:
                 sock, _ = self._listener.accept()
             except OSError:
                 return
-            self._add_channel(sock)
+            if auth.auth_enabled():
+                # Handshake off-thread: a slow or hostile dialer must not
+                # stall accepts for legitimate peers. Bounded — past the
+                # cap, new dialers are dropped instead of accumulating
+                # 20s-timeout threads (connection-flood hardening).
+                if not self._handshake_slots.acquire(blocking=False):
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    continue
+                threading.Thread(
+                    target=self._authenticate_and_add, args=(sock,),
+                    name="fiber-ep-auth", daemon=True,
+                ).start()
+            else:
+                self._add_channel(sock)
+
+    def _authenticate_and_add(self, sock: pysocket.socket) -> None:
+        try:
+            auth.server_handshake(sock)
+        except (OSError, auth.AuthenticationError) as err:
+            logger.warning("rejecting unauthenticated data-plane peer: %s",
+                           err)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        finally:
+            self._handshake_slots.release()
+        self._add_channel(sock)
 
     def _add_channel(self, sock: pysocket.socket) -> None:
         chan = _Channel(sock, self)
@@ -460,11 +512,21 @@ class Device:
         self._native = None
         duplex = in_mode == "rw" and out_mode == "rw"
         if (in_mode, out_mode) in (("r", "w"), ("rw", "rw")):
+            # Same refusal as Endpoint.bind — the native pump must not be
+            # a wildcard-bound bypass of the default-key check.
+            if (ip not in ("127.0.0.1", "localhost")
+                    and auth.auth_enabled()
+                    and auth.cluster_key() == auth.DEFAULT_KEY.encode()):
+                raise TransportClosed(
+                    "refusing to bind the data plane on non-loopback "
+                    f"{ip!r} with the default cluster key; set "
+                    "FIBER_CLUSTER_KEY (fiber-tpu up generates one)"
+                )
             try:
                 from fiber_tpu._native import NativePump, available
 
                 if available():
-                    self._native = NativePump(duplex)
+                    self._native = NativePump(duplex, bind_ip=ip)
             except Exception:
                 self._native = None
         if self._native is not None:

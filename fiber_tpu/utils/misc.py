@@ -97,3 +97,22 @@ def getenv_int(name: str, default: int) -> int:
         return int(os.environ.get(name, default))
     except ValueError:
         return default
+
+
+def package_pythonpath() -> str:
+    """PYTHONPATH value that lets a child interpreter ``import fiber_tpu``
+    regardless of its cwd: the package root prepended to the current
+    PYTHONPATH. Used by every process-spawning seam (launcher jobs, sim
+    agents) — workers must import the framework before any preparation
+    payload arrives."""
+    import fiber_tpu
+
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(fiber_tpu.__file__))
+    )
+    pythonpath = os.environ.get("PYTHONPATH", "")
+    if pkg_root not in pythonpath.split(os.pathsep):
+        pythonpath = (
+            pkg_root + os.pathsep + pythonpath if pythonpath else pkg_root
+        )
+    return pythonpath

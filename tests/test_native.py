@@ -47,3 +47,41 @@ def test_native_device_engaged():
         assert q._device.is_native
     finally:
         q.close()
+
+
+@needs_gxx
+def test_native_pump_rejects_wrong_key():
+    """The C pump must refuse a dialer that can't prove the cluster key
+    (and accept one that can) — the data plane carries pickles."""
+    import socket as pysocket
+
+    from fiber_tpu import auth
+    from fiber_tpu._native import NativePump
+
+    pump = NativePump(duplex=False)
+    try:
+        # wrong key: the server drops us; client_handshake sees EOF or a
+        # failed verification
+        bad = pysocket.create_connection(("127.0.0.1", pump.in_port), 5)
+        with pytest.raises(OSError):
+            auth.client_handshake(bad, key=b"not-the-cluster-key")
+            # server closes only after reading our bad MAC; a subsequent
+            # read observes the close
+            bad.settimeout(5)
+            if not bad.recv(1):
+                raise auth.AuthenticationError("dropped")
+        bad.close()
+
+        # right key: handshake completes and the peer is counted
+        good = pysocket.create_connection(("127.0.0.1", pump.in_port), 5)
+        auth.client_handshake(good, key=auth.cluster_key())
+        deadline = 50
+        while pump.peers("in") < 1 and deadline:
+            import time
+
+            time.sleep(0.02)
+            deadline -= 1
+        assert pump.peers("in") == 1
+        good.close()
+    finally:
+        pump.close()

@@ -202,3 +202,32 @@ def test_large_frame():
     assert pull.recv(30) == blob
     push.close()
     pull.close()
+
+
+def test_endpoint_rejects_wrong_key():
+    """Bound Python endpoints drop peers that fail the HMAC handshake;
+    authenticated peers still deliver (advisor round 1: unauthenticated
+    pickle ingress)."""
+    import socket as pysocket
+
+    from fiber_tpu import auth
+
+    ep = Endpoint("r")
+    addr = ep.bind("127.0.0.1")
+    host, port = addr[len("tcp://"):].rsplit(":", 1)
+    try:
+        bad = pysocket.create_connection((host, int(port)), 5)
+        with pytest.raises(OSError):
+            auth.client_handshake(bad, key=b"wrong-key")
+            bad.settimeout(5)
+            if not bad.recv(1):
+                raise auth.AuthenticationError("dropped")
+        bad.close()
+        assert ep.peer_count() == 0
+
+        sender = Endpoint("w").connect(addr)  # real handshake inside
+        sender.send(b"payload")
+        assert ep.recv(5) == b"payload"
+        sender.close()
+    finally:
+        ep.close()

@@ -244,3 +244,35 @@ def condition_consumer(cond, ns, out_q):
         while not ns.ready:
             cond.wait(30)
     out_q.put("saw ready")
+
+
+def jax_distributed_psum_check(rank, size):
+    """Each rank joins one jax.distributed runtime (the TPU pod path on a
+    CPU mesh): devices must span all processes and a global shard_map
+    psum must see every process's shard."""
+    import numpy as np
+
+    import jax
+
+    # The initializer already ran jax.distributed.initialize; the mesh
+    # below spans BOTH processes' devices.
+    assert jax.process_count() == size, jax.process_count()
+    n = len(jax.devices())
+    assert n == size * len(jax.local_devices()), (n, jax.local_devices())
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    sharding = NamedSharding(mesh, P("x"))
+    x = jax.make_array_from_callback(
+        (n,), sharding, lambda idx: np.arange(n, dtype=np.float32)[idx]
+    )
+    f = jax.jit(jax.shard_map(
+        lambda v: jax.lax.psum(v, "x"),
+        mesh=mesh, in_specs=P("x"), out_specs=P(),
+    ))
+    y = f(x)
+    local = np.asarray(y.addressable_shards[0].data)
+    expected = n * (n - 1) / 2  # sum over the global arange
+    assert float(local.ravel()[0]) == expected, (local, expected)
+    jax.distributed.shutdown()

@@ -15,6 +15,7 @@
 #include <arpa/inet.h>
 #include <atomic>
 #include <cerrno>
+#include <chrono>
 #include <cstdint>
 #include <cstring>
 #include <deque>
@@ -192,6 +193,7 @@ struct Conn {
   bool in_side = false;          // accepted on the in-listener
   bool authed = false;           // handshake complete (always true w/o key)
   uint8_t nonce[kNonceLen] = {}; // server challenge sent to this peer
+  std::chrono::steady_clock::time_point auth_deadline{};
   // read state machine
   std::vector<uint8_t> rbuf;
   size_t rpos = 0;               // consumed offset into rbuf
@@ -222,8 +224,14 @@ struct Device {
   std::atomic<bool> stop{false};
   std::atomic<int> n_in{0}, n_out{0};
   std::vector<uint8_t> key;  // empty = handshake disabled
+  int n_unauthed = 0;        // flood guard (matches tcp.py's 64-slot cap)
   std::thread thr;
 };
+
+// Flood hardening, mirroring the Python acceptor: at most this many
+// connections may sit in the pre-auth state, and each gets a deadline.
+constexpr int kMaxUnauthed = 128;
+constexpr auto kAuthTimeout = std::chrono::seconds(20);
 
 // bind_ip empty/null = INADDR_ANY; otherwise the specific interface (the
 // data plane must not ride every NIC for loopback-only backends).
@@ -311,6 +319,7 @@ void pump_all(Device* d) {
 // Auth complete: the peer becomes a forwarding target and (producers)
 // receives its standing credit window.
 void promote_conn(Device* d, Conn* c) {
+  if (!d->key.empty() && !c->authed) d->n_unauthed--;
   c->authed = true;
   (c->in_side ? d->in_fds : d->out_fds).push_back(c->fd);
   (c->in_side ? d->n_in : d->n_out).fetch_add(1);
@@ -437,7 +446,11 @@ void drop_conn(Device* d, int fd) {
   };
   scrub(d->in_fds);
   scrub(d->out_fds);
-  if (c->authed) (c->in_side ? d->n_in : d->n_out).fetch_sub(1);
+  if (c->authed) {
+    (c->in_side ? d->n_in : d->n_out).fetch_sub(1);
+  } else if (!d->key.empty()) {
+    d->n_unauthed--;
+  }
   delete c;
 }
 
@@ -445,6 +458,10 @@ void on_accept(Device* d, int listen_fd, bool in_side) {
   for (;;) {
     int fd = accept4(listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
     if (fd < 0) return;
+    if (!d->key.empty() && d->n_unauthed >= kMaxUnauthed) {
+      ::close(fd);  // flood: refuse rather than accumulate pre-auth state
+      continue;
+    }
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
     Conn* c = new Conn();
@@ -462,6 +479,8 @@ void on_accept(Device* d, int listen_fd, bool in_side) {
     } else {
       // challenge first; the peer joins the forwarding lists only after
       // handle_frame verifies its response
+      d->n_unauthed++;
+      c->auth_deadline = std::chrono::steady_clock::now() + kAuthTimeout;
       fill_random(c->nonce, kNonceLen);
       queue_write(d, c, auth_frame(c->nonce, kNonceLen));
     }
@@ -492,6 +511,17 @@ void run_loop(Device* d) {
         if (d->conns.find(fd) == d->conns.end()) continue;
       }
       if (evs & EPOLLOUT) on_writable(d, c);
+    }
+    if (!d->key.empty() && d->n_unauthed > 0) {
+      // reap peers that never completed the handshake (500ms tick)
+      auto now = std::chrono::steady_clock::now();
+      std::vector<int> stale;
+      for (auto& kv : d->conns) {
+        if (!kv.second->authed && now > kv.second->auth_deadline) {
+          stale.push_back(kv.first);
+        }
+      }
+      for (int sfd : stale) drop_conn(d, sfd);
     }
     pump_all(d);
   }

@@ -51,8 +51,11 @@ class AuthenticationError(OSError):
 
 def cluster_key() -> bytes:
     """Shared secret for every authenticated plane (agents, managers, and
-    the data plane): FIBER_CLUSTER_KEY or the development default."""
-    return os.environ.get("FIBER_CLUSTER_KEY", DEFAULT_KEY).encode()
+    the data plane): FIBER_CLUSTER_KEY or the development default. An
+    empty value counts as unset — a zero-length key would silently mean
+    "auth enabled" to the Python plane but "auth disabled" to the native
+    plane (key_len == 0), and would dodge the default-key bind refusals."""
+    return (os.environ.get("FIBER_CLUSTER_KEY") or DEFAULT_KEY).encode()
 
 
 def auth_enabled() -> bool:

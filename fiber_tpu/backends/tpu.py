@@ -201,8 +201,18 @@ class TpuBackend(Backend):
         host = self._pick_host(job_spec)
         agent = self._agent(host)
         env = dict(job_spec.env or {})
+        # Resource hints become agent-enforced limits (affinity + rlimit),
+        # the reference's k8s/docker limit role. Device jobs keep all host
+        # cores — pinning a jax host process to cpu_per_job cores would
+        # starve its runtime threads.
+        limits = {}
+        if job_spec.cpu and not (job_spec.tpu or job_spec.gpu):
+            limits["cpu"] = int(job_spec.cpu)
+        if job_spec.mem:
+            limits["mem"] = int(job_spec.mem)
         pid, log_path = agent.call(
-            "spawn", job_spec.command, job_spec.cwd, env, job_spec.name
+            "spawn", job_spec.command, job_spec.cwd, env, job_spec.name,
+            limits,
         )
         job = Job({"host": host, "pid": pid, "log": log_path},
                   jid=f"{host[0]}:{host[1]}/{pid}")
@@ -301,6 +311,25 @@ class TpuBackend(Backend):
                  mode: int = 0o644) -> None:
         for host in (hosts or self._hosts):
             self._agent(host).call("put_file", path, data, mode)
+
+    def stage_code(self, digest: str, files) -> bool:
+        """Push the workspace snapshot to every agent, content-addressed:
+        a host that already has ``code/<digest>/.fiber-complete`` is
+        skipped, so repeat spawns and repeat runs cost one RPC per host."""
+        rel_root = f"code/{digest}"
+        marker = f"{rel_root}/.fiber-complete"
+        for host in self._hosts:
+            agent = self._agent(host)
+            try:
+                agent.call("get_file", marker)
+                continue  # this host already has the snapshot
+            except Exception:
+                pass
+            for rel, data, mode in files:
+                agent.call("put_file", f"{rel_root}/{rel}", data, mode)
+            # Written last: a crashed staging run is retried, not trusted.
+            agent.call("put_file", marker, b"ok", 0o644)
+        return True
 
     def get_file(self, path: str, host=None) -> bytes:
         host = host or self._hosts[0]

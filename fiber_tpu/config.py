@@ -47,6 +47,10 @@ DEFAULTS: Dict[str, Any] = {
     "tpu_zone": "",
     "tpu_project": "",
     "tpu_hosts": "",          # comma-separated host list override / sim hosts
+    # Ship the master's cwd source tree to cluster hosts at spawn (the
+    # Docker-image role in the reference): "auto" = on for backends with
+    # staging support (tpu agents), "off" = never.
+    "code_staging": "auto",
     "mesh_shape": "",         # e.g. "8" or "4x2"; "" = all local devices
     # --- misc ---
     "debug": False,

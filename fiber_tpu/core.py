@@ -114,6 +114,15 @@ class Backend:
         """Live jobs created by this backend (leak-check fixture support)."""
         return []
 
+    def stage_code(self, digest: str, files) -> bool:
+        """Distribute a content-addressed workspace snapshot to every host
+        (``files`` = [(relpath, bytes, mode), ...]). Returns True when the
+        snapshot is available cluster-wide under the agents' staging roots
+        (``{FIBER_STAGING}/code/<digest>``); False = backend has no remote
+        hosts, nothing to do. The Docker-image role of the reference
+        (fiber/cli.py:218-414) without a container registry."""
+        return False
+
     def child_env(self) -> Dict[str, str]:
         """Extra environment for spawned jobs (e.g. resolved cluster
         addresses so children dial the parent's cluster instead of

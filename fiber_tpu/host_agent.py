@@ -39,9 +39,11 @@ def cluster_authkey() -> bytes:
 
 
 class _AgentJob:
-    def __init__(self, proc: subprocess.Popen, log_path: str) -> None:
+    def __init__(self, proc: subprocess.Popen, log_path: str,
+                 cpu: int = 0) -> None:
         self.proc = proc
         self.log_path = log_path
+        self.cpu = cpu  # cores reserved (0 = unlimited)
 
 
 #: Completed-job records kept before the oldest are pruned (their logs too).
@@ -63,7 +65,9 @@ class HostAgent:
     def __init__(self, port: int, authkey: Optional[bytes] = None,
                  bind: str = "127.0.0.1",
                  staging_root: Optional[str] = None,
-                 restrict_files: bool = True) -> None:
+                 restrict_files: bool = True,
+                 strict_resources: bool = False,
+                 exit_on_shutdown: bool = False) -> None:
         if (bind not in ("127.0.0.1", "localhost")
                 and authkey is None
                 and "FIBER_CLUSTER_KEY" not in os.environ):
@@ -80,6 +84,16 @@ class HostAgent:
             staging_root or default_staging_root()
         )
         self._restrict_files = restrict_files
+        # strict: reject spawns whose cpu reservation would oversubscribe
+        # this host (off by default — sim clusters run many agents on one
+        # machine and must share cores).
+        self._strict_resources = strict_resources
+        self._core_rr = 0  # rotating start for affinity placement
+        self._pending_cpu = 0  # reservations between check and job insert
+        # Standalone daemons hard-exit on the shutdown op; embedded agents
+        # (tests, tooling) must only stop serving — os._exit(0) from a
+        # library call would kill the host interpreter silently.
+        self._exit_on_shutdown = exit_on_shutdown
         self._listener = Listener(
             (bind, port), authkey=authkey or cluster_authkey()
         )
@@ -118,7 +132,8 @@ class HostAgent:
         except (EOFError, OSError):
             pass
         except SystemExit:
-            os._exit(0)
+            if self._exit_on_shutdown:
+                os._exit(0)
         finally:
             try:
                 conn.close()
@@ -135,12 +150,75 @@ class HostAgent:
     def _op_ping(self) -> str:
         return "pong"
 
-    def _op_spawn(self, command, cwd, env, name) -> Tuple[int, str]:
+    def _limits_exec(self, command, cpu: Optional[int],
+                     mem: Optional[int]) -> list:
+        """Wrap ``command`` in a bootstrap that applies CPU affinity and an
+        address-space rlimit, then execs the real job (reference: JobSpecs
+        become enforced k8s/docker resource limits,
+        fiber/kubernetes_backend.py:80-101, fiber/docker_backend.py:63-102).
+        An exec wrapper instead of preexec_fn: preexec_fn can deadlock in a
+        threaded parent like this agent."""
+        parts = ["import os,resource,sys"]
+        if cpu:
+            cores = sorted(os.sched_getaffinity(0))
+            with self._lock:  # spawns run on per-connection threads
+                start = self._core_rr % len(cores)
+                self._core_rr += cpu
+            chosen = tuple(
+                cores[(start + i) % len(cores)]
+                for i in range(min(cpu, len(cores)))
+            )
+            parts.append(f"os.sched_setaffinity(0, {chosen!r})")
+        if mem:
+            limit = int(mem) << 20  # MiB -> bytes
+            parts.append(
+                "resource.setrlimit(resource.RLIMIT_AS, "
+                f"({limit}, {limit}))"
+            )
+        parts.append("os.execvp(sys.argv[1], sys.argv[1:])")
+        return [sys.executable, "-c", ";".join(parts)] + list(command)
+
+    def _op_spawn(self, command, cwd, env, name,
+                  limits: Optional[dict] = None) -> Tuple[int, str]:
+        limits = limits or {}
+        cpu = limits.get("cpu")
+        mem = limits.get("mem")
+        ncpu = os.cpu_count() or 1
+        if cpu and cpu > ncpu:
+            raise ValueError(
+                f"cpu reservation {cpu} exceeds host cores {ncpu}"
+            )
+        reserved = 0
+        if cpu and self._strict_resources:
+            # Check AND reserve in one critical section — concurrent
+            # spawn threads must not both pass the check before either
+            # records its reservation (TOCTOU).
+            with self._lock:
+                in_use = self._pending_cpu + sum(
+                    j.cpu for j in self._jobs.values()
+                    if j.cpu and j.proc.poll() is None
+                )
+                if in_use + cpu > ncpu:
+                    raise ValueError(
+                        f"cpu over-subscription: {in_use} reserved + {cpu} "
+                        f"requested > {ncpu} cores"
+                    )
+                self._pending_cpu += cpu
+                reserved = cpu
+        if cpu or mem:
+            command = self._limits_exec(command, cpu, mem)
         log_fd, log_path = tempfile.mkstemp(
             prefix=f"fiber-agent-{name or 'job'}-", suffix=".log"
         )
         full_env = dict(os.environ)
-        full_env.update(env or {})
+        # Masters can't know each host's staging root when they build the
+        # job env, so they send a placeholder this agent resolves (used by
+        # code staging: PYTHONPATH={FIBER_STAGING}/code/<digest>:...).
+        full_env.update({
+            k: v.replace("{FIBER_STAGING}", self._staging_root)
+            if isinstance(v, str) else v
+            for k, v in (env or {}).items()
+        })
         try:
             proc = subprocess.Popen(
                 list(command),
@@ -157,12 +235,15 @@ class HostAgent:
                 os.unlink(log_path)
             except OSError:
                 pass
+            with self._lock:
+                self._pending_cpu -= reserved
             raise
         os.close(log_fd)
         with self._lock:
+            self._pending_cpu -= reserved
             self._next_jid += 1
             jid = self._next_jid
-            self._jobs[jid] = _AgentJob(proc, log_path)
+            self._jobs[jid] = _AgentJob(proc, log_path, cpu=int(cpu or 0))
         self._prune_finished()
         return jid, log_path
 
@@ -298,10 +379,15 @@ def main(argv=None) -> int:
                              "(default: ~/.fiber_tpu/staging)")
     parser.add_argument("--unrestricted-files", action="store_true",
                         help="allow put_file/get_file anywhere on disk")
+    parser.add_argument("--strict-resources", action="store_true",
+                        help="reject spawns whose cpu reservations would "
+                             "oversubscribe this host")
     args = parser.parse_args(argv)
     agent = HostAgent(args.port, bind=args.bind,
                       staging_root=args.staging_root,
-                      restrict_files=not args.unrestricted_files)
+                      restrict_files=not args.unrestricted_files,
+                      strict_resources=args.strict_resources,
+                      exit_on_shutdown=True)
     if args.announce:
         print(f"AGENT_PORT {agent.port}", flush=True)
     # Die with the parent where supported (sim clusters).

@@ -36,6 +36,13 @@ from fiber_tpu.utils.logging import get_logger
 
 logger = get_logger()
 
+#: backend -> {digests} already staged by this master. Weak keys: entries
+#: die with the backend, and (unlike id() keys) can never alias a new
+#: backend allocated at a recycled address.
+import weakref
+
+_staged_ok: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
 _ident_lock = threading.Lock()
 _ident_counter = int.from_bytes(os.urandom(6), "big")
 
@@ -126,8 +133,18 @@ class JobLauncher:
 
     def _job_spec(self, process_obj, cmd) -> JobSpec:
         cfg = config.get()
-        hints: Dict[str, Any] = get_meta(process_obj._target) if process_obj._target else {}
-        cpu = hints.get("cpu", cfg.cpu_per_job)
+        hints: Dict[str, Any] = (
+            getattr(process_obj, "meta_hints", None)
+            or (get_meta(process_obj._target) if process_obj._target else {})
+        )
+        needs_device_hint = bool(
+            hints.get("tpu") or hints.get("gpu") or hints.get("device")
+        )
+        # Device jobs get no default cpu reservation (their host runtime
+        # needs every core unless the user explicitly caps it).
+        cpu = hints.get(
+            "cpu", None if needs_device_hint else cfg.cpu_per_job
+        )
         mem = hints.get("mem", cfg.mem_per_job or None)
         # The worker interpreter must be able to import fiber_tpu *before*
         # the preparation frame (which carries the full sys.path) arrives,
@@ -135,10 +152,14 @@ class JobLauncher:
         from fiber_tpu.utils.misc import package_pythonpath
 
         env = {"FIBER_WORKER": "1", "PYTHONPATH": package_pythonpath()}
-        needs_device = bool(
-            hints.get("tpu") or hints.get("gpu") or hints.get("device")
-        )
-        if cfg.worker_lite and not needs_device:
+        if cfg.code_staging != "off":
+            staged = self._ensure_code_staged()
+            if staged:
+                # Placeholder resolved by each host agent to ITS staging
+                # root; the worker puts the snapshot first on sys.path.
+                env["FIBER_STAGED_CODE"] = staged
+                env["PYTHONPATH"] = staged + os.pathsep + env["PYTHONPATH"]
+        if cfg.worker_lite and not needs_device_hint:
             # Host-plane-only workers: suppress the accelerator plugin's
             # interpreter-boot preload (e.g. the axon sitecustomize gates
             # on this var) — saves ~1s of jax import per worker spawn.
@@ -157,6 +178,32 @@ class JobLauncher:
             cwd=os.getcwd(),
             host_hint=getattr(process_obj, "_host_hint", None),
         )
+
+    def _ensure_code_staged(self) -> str:
+        """Stage the workspace snapshot through the backend (once per
+        (backend, digest) per master); returns the worker-side snapshot
+        path with the ``{FIBER_STAGING}`` placeholder, or ""."""
+        from fiber_tpu.core import Backend
+        from fiber_tpu.utils.staging import get_workspace_snapshot
+
+        # Only walk/hash the workspace for backends that actually override
+        # stage_code — the base no-op would discard the snapshot anyway.
+        if type(self.backend).stage_code is Backend.stage_code:
+            return ""
+        try:
+            digest, files = get_workspace_snapshot()
+            if not files:
+                return ""
+            staged = _staged_ok.setdefault(self.backend, set())
+            if digest not in staged:
+                if not self.backend.stage_code(digest, files):
+                    return ""
+                staged.add(digest)
+            return "{FIBER_STAGING}/code/" + digest
+        except Exception:
+            logger.exception("code staging failed; workers rely on a "
+                             "shared filesystem for user modules")
+            return ""
 
     def _preparation_data(self, process_obj) -> Dict[str, Any]:
         """Config + main-module info the worker needs before unpickling the

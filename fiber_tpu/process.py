@@ -46,12 +46,18 @@ class Process:
         daemon: Optional[bool] = None,
         backend: Optional[str] = None,
         host_hint: Optional[str] = None,
+        meta_hints: Optional[Dict[str, Any]] = None,
     ) -> None:
         if group is not None:
             raise ValueError("process group argument must be None")
         self._target = target
         self._args = tuple(args)
         self._kwargs = dict(kwargs or {})
+        # Explicit resource hints override the target's @meta attributes —
+        # wrappers like Ring forward the *user* function's hints onto
+        # processes whose direct target is framework plumbing (reference:
+        # fiber/experimental/ring.py:78-82).
+        self.meta_hints = dict(meta_hints) if meta_hints else None
         self._name = name or f"Process-{next(_counter)}"
         self._daemonic = bool(daemon) if daemon is not None else False
         self._authkey = bytes(current_process().authkey)

@@ -1,0 +1,108 @@
+"""Workspace snapshot for cluster code distribution.
+
+The reference ships user code to every node as a Docker image built and
+pushed by ``fiber run`` (fiber/cli.py:218-414, with the default image
+baked in fiber/config.py:84). fiber_tpu hosts share a Python install but
+not necessarily a filesystem, so the TPU-native equivalent is a content-
+addressed *workspace snapshot*: the master's cwd source tree, hashed and
+staged once through the host agents; workers put the staged copy first on
+``sys.path``.
+
+Only small text/source files are shipped (the allowlist below) — Python
+dependencies are expected on every host (the pod VM image plays the
+Docker base-image role).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import List, Optional, Tuple
+
+from fiber_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+#: File types worth shipping to workers. Everything else (data sets,
+#: checkpoints, compiled artifacts) should move via explicit ``fiber-tpu
+#: cp`` or shared storage.
+STAGE_EXTENSIONS = frozenset({
+    ".py", ".json", ".yaml", ".yml", ".toml", ".cfg", ".ini", ".txt",
+    ".csv", ".proto",
+})
+SKIP_DIRS = frozenset({
+    "__pycache__", "node_modules", "venv", ".venv", "build", "dist",
+    "site-packages", ".eggs",
+})
+MAX_FILES = 4000
+MAX_TOTAL_BYTES = 32 << 20
+MAX_FILE_BYTES = 4 << 20
+
+_snapshot_cache: Optional[Tuple[str, List[Tuple[str, bytes, int]]]] = None
+
+
+def collect_workspace(
+    root: Optional[str] = None,
+) -> Tuple[str, List[Tuple[str, bytes, int]]]:
+    """Snapshot ``root`` (default cwd) into ``(digest, files)`` where
+    files is ``[(relpath, content, mode), ...]`` and digest is a sha256
+    over paths+contents (the content address for agent-side caching).
+    Oversized trees are truncated loudly, never silently."""
+    root = os.path.realpath(root or os.getcwd())
+    files: List[Tuple[str, bytes, int]] = []
+    total = 0
+    truncated: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if not d.startswith(".") and d not in SKIP_DIRS
+        )
+        for fn in sorted(filenames):
+            if os.path.splitext(fn)[1] not in STAGE_EXTENSIONS:
+                continue
+            full = os.path.join(dirpath, fn)
+            try:
+                size = os.path.getsize(full)
+            except OSError:
+                continue
+            if size > MAX_FILE_BYTES:
+                truncated.append(full)
+                continue
+            if len(files) >= MAX_FILES or total + size > MAX_TOTAL_BYTES:
+                truncated.append(full)
+                continue
+            try:
+                with open(full, "rb") as fh:
+                    data = fh.read()
+                mode = os.stat(full).st_mode & 0o777
+            except OSError:
+                continue
+            rel = os.path.relpath(full, root)
+            files.append((rel, data, mode))
+            total += size
+    if truncated:
+        logger.warning(
+            "code staging: %d file(s) skipped (size caps); first: %s",
+            len(truncated), truncated[0],
+        )
+    h = hashlib.sha256()
+    for rel, data, _mode in files:
+        h.update(rel.encode())
+        h.update(b"\x00")
+        h.update(data)
+        h.update(b"\x00")
+    return h.hexdigest()[:20], files
+
+
+def get_workspace_snapshot() -> Tuple[str, List[Tuple[str, bytes, int]]]:
+    """Per-process cached snapshot — one walk per master run, so spawning
+    many Processes doesn't re-hash the tree every time."""
+    global _snapshot_cache
+    if _snapshot_cache is None:
+        _snapshot_cache = collect_workspace()
+    return _snapshot_cache
+
+
+def reset_snapshot_cache() -> None:
+    global _snapshot_cache
+    _snapshot_cache = None

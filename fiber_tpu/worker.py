@@ -38,13 +38,42 @@ def _apply_preparation(prep: dict) -> None:
     from fiber_tpu import config
     from fiber_tpu.utils import logging as flogging
 
+    # Staged workspace snapshot (multi-host code distribution): resolved
+    # by the host agent from the {FIBER_STAGING} placeholder. It outranks
+    # the master's sys_path entries — those name master-local directories
+    # that may not exist on this host.
+    staged = os.environ.get("FIBER_STAGED_CODE", "")
+
     cwd = prep.get("cwd")
     if cwd and os.path.isdir(cwd):
         os.chdir(cwd)
+    elif staged and os.path.isdir(staged):
+        # Master's cwd doesn't exist here; the snapshot is its stand-in.
+        os.chdir(staged)
 
     for path in reversed(prep.get("sys_path", [])):
         if path not in sys.path:
             sys.path.insert(0, path)
+    if staged and os.path.isdir(staged):
+        # The snapshot mirrors the master's cwd tree, but user modules may
+        # live on sys.path entries BELOW cwd (e.g. the script's own
+        # directory, auto-inserted by the interpreter). Map each such
+        # entry to its staged twin and give the twins top precedence.
+        master_cwd = prep.get("cwd") or ""
+        twins = [staged]
+        for path in prep.get("sys_path", []):
+            if not master_cwd or not path:
+                continue
+            rel = os.path.relpath(path, master_cwd)
+            if rel == "." or rel.startswith(".."):
+                continue
+            candidate = os.path.normpath(os.path.join(staged, rel))
+            if os.path.isdir(candidate):
+                twins.append(candidate)
+        for candidate in reversed(twins):
+            if candidate in sys.path:
+                sys.path.remove(candidate)
+            sys.path.insert(0, candidate)
 
     config.init_from(prep["fiber_config"])
 
@@ -64,6 +93,15 @@ def _apply_preparation(prep: dict) -> None:
     # Re-import the user's entry module so functions pickled by reference
     # against __main__ resolve (the stdlib spawn fixups are the canonical
     # implementation of this dance).
+    main_path = prep.get("init_main_from_path")
+    if (main_path and not os.path.exists(main_path)
+            and staged and cwd):
+        # The master's script path doesn't exist on this host; its copy in
+        # the staged snapshot (rooted at the master's cwd) does.
+        rel = os.path.relpath(main_path, cwd)
+        candidate = os.path.join(staged, rel)
+        if not rel.startswith("..") and os.path.exists(candidate):
+            prep["init_main_from_path"] = candidate
     try:
         if "init_main_from_name" in prep:
             mp_spawn._fixup_main_from_name(prep["init_main_from_name"])

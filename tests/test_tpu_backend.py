@@ -137,3 +137,122 @@ def test_default_pool_size_fills_hosts(sim_backend):
         assert sim_backend.default_pool_size() == 8
     finally:
         config.get().update(cpu_per_job=old)
+
+
+def test_spawn_enforces_cpu_affinity(sim_backend):
+    """JobSpec.cpu becomes a real CPU-affinity limit in the spawned job
+    (reference: k8s resource limits, fiber/kubernetes_backend.py:80-101)."""
+    spec = JobSpec(
+        command=[sys.executable, "-c",
+                 "import os; print('CORES', len(os.sched_getaffinity(0)))"],
+        cpu=1,
+    )
+    job = sim_backend.create_job(spec)
+    assert sim_backend.wait_for_job(job, 15) == 0
+    assert "CORES 1" in sim_backend.get_job_logs(job)
+
+
+def test_spawn_enforces_mem_rlimit(sim_backend):
+    """JobSpec.mem (MiB) becomes RLIMIT_AS: an allocation past the limit
+    dies with MemoryError instead of eating the host."""
+    spec = JobSpec(
+        command=[sys.executable, "-c",
+                 "x = bytearray(512 << 20); print('ALLOCATED')"],
+        mem=128,
+    )
+    job = sim_backend.create_job(spec)
+    rc = sim_backend.wait_for_job(job, 15)
+    logs = sim_backend.get_job_logs(job)
+    assert rc != 0 and "ALLOCATED" not in logs, (rc, logs)
+    assert "MemoryError" in logs
+
+
+def test_spawn_rejects_overcommitted_cpu(sim_backend):
+    """A single reservation larger than the host is refused outright."""
+    import os
+
+    spec = JobSpec(command=[sys.executable, "-c", "pass"],
+                   cpu=(os.cpu_count() or 1) + 1)
+    with pytest.raises(Exception, match="exceeds host cores"):
+        sim_backend.create_job(spec)
+
+
+def test_strict_resources_rejects_oversubscription(tmp_path):
+    """--strict-resources agents track live reservations cumulatively."""
+    import os
+    import threading
+
+    from fiber_tpu.host_agent import HostAgent
+
+    agent = HostAgent(0, bind="127.0.0.1", strict_resources=True)
+    threading.Thread(target=agent.serve_forever, daemon=True).start()
+    client = AgentClient("127.0.0.1", agent.port)
+    ncpu = os.cpu_count() or 1
+    try:
+        jid, _ = client.call(
+            "spawn",
+            [sys.executable, "-c", "import time; time.sleep(5)"],
+            None, {}, "hog", {"cpu": ncpu},
+        )
+        with pytest.raises(Exception, match="over-subscription"):
+            client.call(
+                "spawn", [sys.executable, "-c", "pass"],
+                None, {}, "late", {"cpu": 1},
+            )
+        client.call("signal", jid, 15)
+        client.call("wait", jid, 10)
+    finally:
+        try:
+            client.call("shutdown")
+        except Exception:
+            pass
+        client.close()
+
+
+def test_code_staging_ships_user_module(tmp_path):
+    """A user module next to the master's script reaches cluster workers
+    through the agent staging plane with zero manual `fiber-tpu cp` —
+    the reference's Docker-image role (fiber/cli.py:218-414). The worker
+    must import the STAGED copy (first on sys.path), proving the code
+    travelled through the agents rather than the shared filesystem."""
+    import os
+
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "staged_usermod.py").write_text(
+        "def probe(q):\n"
+        "    q.put(__file__)\n"
+    )
+    (proj / "main.py").write_text(
+        "import fiber_tpu\n"
+        "import staged_usermod\n"
+        "q = fiber_tpu.SimpleQueue()\n"
+        "p = fiber_tpu.Process(target=staged_usermod.probe, args=(q,))\n"
+        "p.start()\n"
+        "path = q.get(60)\n"
+        "p.join(30)\n"
+        "print('USERMOD_AT', path)\n"
+    )
+    env = dict(os.environ)
+    env.update({
+        "FIBER_BACKEND": "tpu",
+        "FIBER_TPU_HOSTS": "sim:2",
+        "FIBER_AGENT_STAGING": str(tmp_path / "stage"),
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.getcwd() + os.pathsep
+        + os.environ.get("PYTHONPATH", ""),
+    })
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # Run from the PARENT of the script dir: the worker must map the
+    # interpreter-inserted script-dir sys.path entry onto its staged twin
+    # (snapshot root = master cwd, module lives one level down).
+    out = subprocess.run(
+        [sys.executable, str(proj / "main.py")],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=180,
+    )
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    line = [l for l in out.stdout.splitlines() if "USERMOD_AT" in l][0]
+    staged_path = line.split(" ", 1)[1]
+    assert str(tmp_path / "stage") in staged_path, staged_path
+    assert "/code/" in staged_path, staged_path

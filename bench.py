@@ -67,6 +67,15 @@ def main() -> int:
                         help="episode length (CartPole-v1 uses 500)")
     parser.add_argument("--gens", type=int, default=10)
     parser.add_argument("--init-timeout", type=float, default=600.0)
+    parser.add_argument("--no-pool-bench", action="store_true",
+                        help="skip the host Pool.map overhead section")
+    parser.add_argument("--poet", action="store_true",
+                        help="run the POET co-evolution workload instead "
+                             "of plain ES (the gecco-2020 north-star "
+                             "shape); emits a poet metric line")
+    parser.add_argument("--ab-pallas", action="store_true",
+                        help="also time the ES with use_pallas forced off "
+                             "and report both (TPU A/B)")
     args = parser.parse_args()
     if args.gens < 1:
         parser.error("--gens must be >= 1")
@@ -97,6 +106,9 @@ def main() -> int:
 
     devices = jax.devices()
     watchdog.cancel()
+
+    if args.poet:
+        return _poet_bench(args, devices)
 
     import numpy as np
     from jax.sharding import Mesh
@@ -157,9 +169,141 @@ def main() -> int:
         "platform": devices[0].platform,
         "env_steps_per_sec": round(evals_per_sec * args.steps, 1),
         "mean_fitness": float(jax.device_get(stats)[0]),
+        "use_pallas": bool(es.use_pallas),
     }
+
+    # The sections below are additive: a failure in any of them must not
+    # discard the ES number already measured — the one-JSON-line contract
+    # holds no matter what (errors ride along in the line instead).
+    if args.ab_pallas and es.use_pallas:
+        try:
+            # Same workload, pallas kernels forced off: the recorded A/B
+            # for the regenerate-don't-store noise path.
+            es_off = EvolutionStrategy(
+                eval_fn, dim=policy.dim, pop_size=args.pop, sigma=0.1,
+                lr=0.03, mesh=mesh, use_pallas=False,
+            )
+            key, k = jax.random.split(key)
+            p2, warm2 = es_off.run_fused(params, k, args.gens)
+            jax.block_until_ready(warm2)
+            t0 = time.perf_counter()
+            key, k = jax.random.split(key)
+            _, s2 = es_off.run_fused(p2, k, args.gens)
+            jax.block_until_ready(s2)
+            off_elapsed = time.perf_counter() - t0
+            result["evals_per_sec_no_pallas"] = round(
+                total_evals / off_elapsed, 2)
+            result["pallas_speedup"] = round(off_elapsed / elapsed, 3)
+        except Exception as err:  # noqa: BLE001
+            result["ab_pallas_error"] = repr(err)
+
+    if not args.no_pool_bench:
+        try:
+            result.update(_pool_bench())
+        except Exception as err:  # noqa: BLE001
+            result["pool_bench_error"] = repr(err)
+
     _emit(result)
     return 0
+
+
+def _poet_bench(args, devices) -> int:
+    """POET env/agent co-evolution end-to-end (the reference's
+    examples/gecco-2020 workload shape): reports evals/s plus the
+    co-evolution trajectory (pairs grown, transfers, fitness)."""
+    import jax
+
+    from fiber_tpu.models import MLPPolicy
+    from fiber_tpu.models.envs import ParamCartPole
+    from fiber_tpu.ops.poet import POET
+
+    policy = MLPPolicy(ParamCartPole.obs_dim, ParamCartPole.act_dim,
+                       hidden=(16,))
+    poet = POET(ParamCartPole, policy, pop_size=args.pop, max_pairs=6,
+                rollout_steps=args.steps)
+    iters, es_steps = args.gens, 4
+    t0 = time.perf_counter()
+    history = poet.run(jax.random.PRNGKey(0), iters, es_steps=es_steps)
+    elapsed = time.perf_counter() - t0
+    total_evals = sum(h["pairs"] * poet.pop_size * es_steps
+                      for h in history)
+    per_chip_share = NORTH_STAR_EVALS_PER_SEC / NORTH_STAR_CHIPS
+    _emit({
+        "metric": "poet_policy_evals_per_sec",
+        "value": round(total_evals / elapsed, 2),
+        "unit": "evals/s",
+        "vs_baseline": round(
+            total_evals / elapsed / (per_chip_share * len(devices)), 3),
+        "iterations": iters,
+        "pop_size": poet.pop_size,
+        "rollout_steps": args.steps,
+        "platform": devices[0].platform,
+        "n_devices": len(devices),
+        "final_pairs": history[-1]["pairs"],
+        "total_transfers": sum(h["transfers"] for h in history),
+        "fitness_first_iter": round(history[0]["mean_fitness"], 2),
+        "fitness_last_iter": round(history[-1]["mean_fitness"], 2),
+        "history": history,
+    })
+    return 0
+
+
+def _timed_task(duration):
+    time.sleep(duration)
+    return duration
+
+
+def _dev_square(x):
+    return x * x
+
+
+def _pool_bench() -> dict:
+    """Host-plane Pool.map overhead vs stdlib multiprocessing and the
+    device-path Pool.map throughput (BASELINE.json's first metric). One
+    recorded number replaces the round-1 CHANGELOG/PARITY discrepancy."""
+    import multiprocessing
+
+    # The host-pool section always measures the local backend — a
+    # leftover FIBER_BACKEND=tpu without hosts would otherwise abort it.
+    os.environ["FIBER_BACKEND"] = "local"
+    import numpy as np
+
+    import fiber_tpu
+    from fiber_tpu.meta import meta
+
+    out: dict = {}
+    workers = 4
+
+    def run_one(make_pool, n_tasks, duration):
+        with make_pool(workers) as pool:
+            pool.map(_timed_task, [0.0] * workers)  # spin-up barrier
+            t0 = time.perf_counter()
+            pool.map(_timed_task, [duration] * n_tasks)
+            return time.perf_counter() - t0
+
+    try:
+        fiber_tpu.init(worker_lite=True)
+    except Exception:
+        pass
+    for duration, n_tasks, tag in ((0.001, 600, "1ms"), (0.01, 200, "10ms")):
+        fib = run_one(lambda w: fiber_tpu.Pool(w), n_tasks, duration)
+        mp = run_one(
+            lambda w: multiprocessing.get_context("spawn").Pool(w),
+            n_tasks, duration,
+        )
+        out[f"pool_map_{tag}_tasks_per_sec"] = round(n_tasks / fib, 1)
+        out[f"pool_map_{tag}_overhead_vs_mp"] = round(fib / mp, 3)
+
+    # Device path: @meta(device=True) lowers Pool.map onto the mesh.
+    dev_square = meta(device=True)(_dev_square)
+    items = np.arange(4096.0, dtype=np.float32)
+    with fiber_tpu.Pool() as pool:
+        pool.map(dev_square, items[:64])  # compile
+        t0 = time.perf_counter()
+        pool.map(dev_square, items)
+        out["pool_map_device_tasks_per_sec"] = round(
+            len(items) / (time.perf_counter() - t0), 1)
+    return out
 
 
 if __name__ == "__main__":

@@ -59,8 +59,94 @@ def cmd_run(args) -> int:
     if args.hosts:
         os.environ["FIBER_TPU_HOSTS"] = args.hosts
         os.environ.setdefault("FIBER_BACKEND", "tpu")
+    if args.submit:
+        return _submit_master(args)
     _run_script(args.script, args.script_args)
     return 0
+
+
+def _submit_master(args) -> int:
+    """Launch the *master* as a cluster job (reference: ``fiber run``
+    starts the master in the cluster and attaches to its logs,
+    fiber/cli.py:346-414). The workspace ships via the staging plane;
+    the job runs from the staged snapshot, so its own Processes stage
+    nothing extra and land on the same cluster."""
+    import time
+
+    from fiber_tpu.backends import get_backend
+    from fiber_tpu.core import JobSpec, ProcessStatus
+    from fiber_tpu.utils.misc import package_pythonpath
+    from fiber_tpu.utils.staging import (
+        get_workspace_snapshot,
+        stage_workspace,
+    )
+
+    if args.backend and args.backend != "tpu":
+        raise SystemExit(
+            "error: --submit launches the master through cluster agents "
+            "and requires the tpu backend (drop --backend or use tpu)"
+        )
+    script = os.path.relpath(os.path.abspath(args.script), os.getcwd())
+    if script.startswith(".."):
+        raise SystemExit(
+            "error: --submit requires the script inside the cwd "
+            "(the staged workspace)"
+        )
+    try:
+        backend = get_backend("tpu")
+    except Exception as err:
+        raise SystemExit(f"error: {err}") from None
+    if getattr(backend, "_sim_agents", None) and not args.follow:
+        # Sim agents are children of THIS process: detaching would reap
+        # them at exit and orphan-kill the just-submitted master.
+        raise SystemExit(
+            "error: --submit on a sim cluster requires --follow "
+            "(the simulated agents die with this CLI process)"
+        )
+    digest, _files = get_workspace_snapshot()
+    staged = stage_workspace(backend)
+    if not staged:
+        raise SystemExit("error: backend cannot stage code")
+    # The snapshot filters (extension allowlist, size caps) must not have
+    # dropped the script itself, or the remote job dies at `can't open
+    # file` with the failure visible only in remote logs.
+    staged_paths = {rel for rel, _, _ in get_workspace_snapshot()[1]}
+    if script not in staged_paths:
+        raise SystemExit(
+            f"error: {script!r} is not part of the staged snapshot "
+            "(stageable extensions: .py and small text/config files)"
+        )
+    env = {
+        "FIBER_BACKEND": "tpu",
+        "FIBER_TPU_HOSTS": backend._resolved_hosts_spec(),
+        "FIBER_STAGED_CODE": staged,
+        "PYTHONPATH": staged + os.pathsep + package_pythonpath(),
+    }
+    spec = JobSpec(
+        command=[args.python, script] + list(args.script_args),
+        name="fiber-master",
+        env=env,
+        cwd=staged,
+    )
+    job = backend.create_job(spec)
+    print(f"submitted master job {job.jid}", flush=True)
+    if not args.follow:
+        print(f"# follow with: fiber-tpu status --hosts "
+              f"{backend._resolved_hosts_spec()}")
+        return 0
+    # Attach: stream the log tail incrementally while the job runs.
+    printed = 0
+    while True:
+        running = backend.get_job_status(job) == ProcessStatus.STARTED
+        logs = backend.get_job_logs(job)
+        if len(logs) > printed:
+            sys.stdout.write(logs[printed:])
+            sys.stdout.flush()
+            printed = len(logs)
+        if not running:
+            break
+        time.sleep(1.0)
+    return int(backend.wait_for_job(job, 5) or 0)
 
 
 def cmd_sim(args) -> int:
@@ -191,6 +277,14 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("run", help="run a program on the cluster")
     p.add_argument("--backend", default="")
     p.add_argument("--hosts", default="")
+    p.add_argument("--submit", action="store_true",
+                   help="launch the master itself as a cluster job "
+                        "(submit-and-detach for long pod runs)")
+    p.add_argument("--follow", action="store_true",
+                   help="with --submit: attach and stream the job's log "
+                        "tail until it exits")
+    p.add_argument("--python", default=sys.executable,
+                   help="remote interpreter for --submit")
     p.add_argument("script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     p.set_defaults(fn=cmd_run)

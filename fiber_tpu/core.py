@@ -36,9 +36,13 @@ class JobSpec:
         tpu: Optional[int] = None,
         env: Optional[Dict[str, str]] = None,
         cwd: Optional[str] = None,
-        volumes: Optional[Dict[str, Dict[str, str]]] = None,
         host_hint: Optional[str] = None,
     ) -> None:
+        # NOTE: the reference JobSpec carries ``volumes`` (k8s PVCs,
+        # fiber/core.py:46-51). fiber_tpu deliberately has no such field:
+        # code rides the staging plane (utils/staging.py), artifacts ride
+        # ``fiber-tpu cp`` or shared storage mounted outside the
+        # framework — docs/migration.md.
         self.command = list(command)
         self.image = image
         self.name = name
@@ -48,7 +52,6 @@ class JobSpec:
         self.tpu = tpu
         self.env = dict(env or {})
         self.cwd = cwd
-        self.volumes = dict(volumes or {})
         # Placement hint for multi-host backends (e.g. pin to pod host k).
         self.host_hint = host_hint
 

@@ -219,6 +219,8 @@ class HostAgent:
             if isinstance(v, str) else v
             for k, v in (env or {}).items()
         })
+        if isinstance(cwd, str):
+            cwd = cwd.replace("{FIBER_STAGING}", self._staging_root)
         try:
             proc = subprocess.Popen(
                 list(command),

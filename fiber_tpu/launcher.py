@@ -36,12 +36,6 @@ from fiber_tpu.utils.logging import get_logger
 
 logger = get_logger()
 
-#: backend -> {digests} already staged by this master. Weak keys: entries
-#: die with the backend, and (unlike id() keys) can never alias a new
-#: backend allocated at a recycled address.
-import weakref
-
-_staged_ok: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 _ident_lock = threading.Lock()
 _ident_counter = int.from_bytes(os.urandom(6), "big")
@@ -180,26 +174,11 @@ class JobLauncher:
         )
 
     def _ensure_code_staged(self) -> str:
-        """Stage the workspace snapshot through the backend (once per
-        (backend, digest) per master); returns the worker-side snapshot
-        path with the ``{FIBER_STAGING}`` placeholder, or ""."""
-        from fiber_tpu.core import Backend
-        from fiber_tpu.utils.staging import get_workspace_snapshot
+        """Worker-side staged-snapshot path (placeholder form), or ""."""
+        from fiber_tpu.utils.staging import stage_workspace
 
-        # Only walk/hash the workspace for backends that actually override
-        # stage_code — the base no-op would discard the snapshot anyway.
-        if type(self.backend).stage_code is Backend.stage_code:
-            return ""
         try:
-            digest, files = get_workspace_snapshot()
-            if not files:
-                return ""
-            staged = _staged_ok.setdefault(self.backend, set())
-            if digest not in staged:
-                if not self.backend.stage_code(digest, files):
-                    return ""
-                staged.add(digest)
-            return "{FIBER_STAGING}/code/" + digest
+            return stage_workspace(self.backend)
         except Exception:
             logger.exception("code staging failed; workers rely on a "
                              "shared filesystem for user modules")

@@ -106,3 +106,36 @@ def get_workspace_snapshot() -> Tuple[str, List[Tuple[str, bytes, int]]]:
 def reset_snapshot_cache() -> None:
     global _snapshot_cache
     _snapshot_cache = None
+
+
+import weakref
+
+#: backend -> {digests} already staged by this process. Weak keys: entries
+#: die with the backend and (unlike id() keys) can never alias a new
+#: backend allocated at a recycled address.
+_staged_ok: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def stage_workspace(backend) -> str:
+    """Snapshot the cwd and push it through ``backend.stage_code`` (once
+    per (backend, digest) per process). Returns the worker-side snapshot
+    path with the ``{FIBER_STAGING}`` placeholder each host agent
+    resolves, or "" when the backend has no staging plane or the
+    workspace is empty. Shared by the launcher (per-Process staging) and
+    ``fiber-tpu run --submit`` so masters and workers always agree on
+    the staged layout."""
+    from fiber_tpu.core import Backend
+
+    # Only walk/hash the workspace for backends that actually override
+    # stage_code — the base no-op would discard the snapshot anyway.
+    if type(backend).stage_code is Backend.stage_code:
+        return ""
+    digest, files = get_workspace_snapshot()
+    if not files:
+        return ""
+    staged = _staged_ok.setdefault(backend, set())
+    if digest not in staged:
+        if not backend.stage_code(digest, files):
+            return ""
+        staged.add(digest)
+    return "{FIBER_STAGING}/code/" + digest

@@ -93,3 +93,153 @@ def test_sim_runs_script(tmp_path):
     )
     assert result.returncode == 0, result.stderr[-2000:]
     assert out.read_text() == "ran on sim cluster"
+
+
+def _fake_bin(tmp_path, name, record):
+    """A PATH-shadowing fake for ssh/gcloud that records its argv."""
+    script = tmp_path / name
+    script.write_text(
+        "#!/bin/sh\n"
+        f'echo "$@" >> {record}\n'
+    )
+    script.chmod(0o755)
+    return script
+
+
+def test_up_executes_ssh_per_host(tmp_path, monkeypatch):
+    """`fiber-tpu up --execute`: one ssh per host carrying the agent
+    start command, a generated cluster key, and a non-loopback bind
+    (production bring-up path, reference role: fiber/cli.py:338-414)."""
+    import os
+
+    from fiber_tpu.cli import main
+
+    record = tmp_path / "ssh.log"
+    _fake_bin(tmp_path, "ssh", record)
+    monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ['PATH']}")
+    monkeypatch.delenv("FIBER_CLUSTER_KEY", raising=False)
+
+    rc = main(["up", "--hosts", "10.0.0.1,10.0.0.2", "--execute"])
+    assert rc == 0
+    lines = record.read_text().strip().splitlines()
+    assert len(lines) == 2
+    for line, host in zip(lines, ("10.0.0.1", "10.0.0.2")):
+        assert line.startswith(host)
+        assert "FIBER_CLUSTER_KEY=" in line
+        assert "fiber-tpu-cluster" not in line  # generated, not default
+        assert "-m fiber_tpu.host_agent" in line
+        assert "--bind 0.0.0.0" in line
+
+
+def test_up_executes_gcloud_for_tpu_name(tmp_path, monkeypatch):
+    """`fiber-tpu up --tpu NAME`: drives gcloud compute tpus tpu-vm ssh
+    with --worker all."""
+    import os
+
+    from fiber_tpu.cli import main
+
+    record = tmp_path / "gcloud.log"
+    _fake_bin(tmp_path, "gcloud", record)
+    monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ['PATH']}")
+
+    rc = main(["up", "--tpu", "my-pod", "--zone", "us-central2-b",
+               "--execute"])
+    assert rc == 0
+    line = record.read_text().strip()
+    assert "compute tpus tpu-vm ssh my-pod" in line
+    assert "--zone us-central2-b" in line
+    assert "--worker all" in line
+    assert "fiber_tpu.host_agent" in line
+
+
+def test_backend_discovers_agents_from_tpu_worker_hostnames(monkeypatch):
+    """On a pod slice, TPU_WORKER_HOSTNAMES is the host source: the
+    backend must dial those agents and run jobs on them."""
+    import sys
+    import threading
+
+    from fiber_tpu import config
+    from fiber_tpu.backends.tpu import TpuBackend
+    from fiber_tpu.core import JobSpec
+    from fiber_tpu.host_agent import HostAgent
+
+    agents = [HostAgent(0, bind="127.0.0.1") for _ in range(2)]
+    for a in agents:
+        threading.Thread(target=a.serve_forever, daemon=True).start()
+    names = ",".join(f"127.0.0.1:{a.port}" for a in agents)
+
+    monkeypatch.delenv("FIBER_TPU_HOSTS", raising=False)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", names)
+    old = config.get().tpu_hosts
+    config.get().update(tpu_hosts="")
+    try:
+        backend = TpuBackend()
+        assert backend._hosts == [
+            ("127.0.0.1", agents[0].port), ("127.0.0.1", agents[1].port)
+        ]
+        job = backend.create_job(
+            JobSpec(command=[sys.executable, "-c", "print('pod-ok')"])
+        )
+        assert backend.wait_for_job(job, 15) == 0
+        assert "pod-ok" in backend.get_job_logs(job)
+    finally:
+        config.get().update(tpu_hosts=old)
+        for a in agents:
+            try:
+                a._listener.close()
+            except OSError:
+                pass
+
+
+def test_run_submit_launches_master_in_cluster(tmp_path, monkeypatch):
+    """`fiber-tpu run --submit --follow`: the master itself becomes a
+    cluster job, running from the staged snapshot, and its own Processes
+    land on the same cluster (reference: fiber/cli.py:346-414)."""
+    import os
+    import subprocess as sp
+    import sys
+
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "job_main.py").write_text(
+        "import os\n"
+        "import fiber_tpu\n"
+        "def leaf(q):\n"
+        "    q.put(os.getcwd())\n"
+        "if __name__ == '__main__':\n"
+        "    q = fiber_tpu.SimpleQueue()\n"
+        "    p = fiber_tpu.Process(target=leaf, args=(q,))\n"
+        "    p.start()\n"
+        "    print('LEAF_CWD', q.get(60))\n"
+        "    p.join(30)\n"
+        "    print('MASTER_DONE', os.getcwd())\n"
+    )
+    env = dict(os.environ)
+    env.update({
+        "FIBER_BACKEND": "tpu",
+        "FIBER_TPU_HOSTS": "sim:2",
+        "FIBER_AGENT_STAGING": str(tmp_path / "stage"),
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.getcwd() + os.pathsep
+        + env_get_pythonpath(),
+    })
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = sp.run(
+        [sys.executable, "-m", "fiber_tpu.cli", "run", "--submit",
+         "--follow", "job_main.py"],
+        cwd=str(proj), env=env, capture_output=True, text=True,
+        timeout=240,
+    )
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "submitted master job" in out.stdout
+    assert "MASTER_DONE" in out.stdout, out.stdout
+    # master ran from the staged snapshot, not the submit cwd
+    master_cwd = [l for l in out.stdout.splitlines()
+                  if "MASTER_DONE" in l][0].split(" ", 1)[1]
+    assert str(tmp_path / "stage") in master_cwd, master_cwd
+
+
+def env_get_pythonpath():
+    import os
+
+    return os.environ.get("PYTHONPATH", "")

@@ -30,6 +30,58 @@ def _emit(result: dict) -> None:
     print(json.dumps(result), flush=True)
 
 
+def _probe_accelerator(timeout: float) -> str:
+    """What does a fresh interpreter see? "accel", "cpu" (jax healthy but
+    no accelerator — deterministic, don't retry), or "wedged" (hung or
+    crashed init — transient, retry). Probed in a SUBPROCESS so a wedged
+    backend init (the axon tunnel can hang forever inside
+    make_c_api_client) never poisons this process."""
+    import subprocess
+
+    code = (
+        "import jax; d = jax.devices(); "
+        "import sys; sys.exit(0 if d[0].platform != 'cpu' else 3)"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+    except subprocess.TimeoutExpired:
+        return "wedged"
+    if proc.returncode == 0:
+        return "accel"
+    return "cpu" if proc.returncode == 3 else "wedged"
+
+
+def _resolve_platform(args) -> None:
+    """Decide the jax platform BEFORE importing jax here: explicit
+    --platform wins; otherwise probe the accelerator, retrying with
+    backoff only on *wedge* answers (transient tunnel hangs heal;
+    a healthy CPU-only answer is final), and drop to CPU explicitly —
+    labeled in the JSON — when it stays unreachable."""
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+        if args.platform == "cpu":
+            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        return
+    for attempt in range(3):
+        state = _probe_accelerator(timeout=120.0)
+        if state == "accel":
+            return  # leave the environment's accelerator platform alone
+        if state == "cpu":
+            break  # deterministic: no accelerator attached
+        if attempt < 2:
+            time.sleep(20.0 * (attempt + 1))
+    print("bench: accelerator unreachable; falling back to cpu",
+          file=sys.stderr)
+    args.platform = "cpu"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+
 def _watchdog(seconds: float, payload: dict, fallback_cpu: bool = False):
     """If the accelerator wedges: re-exec on the CPU platform (the JSON's
     ``platform`` field makes the substitution explicit) or, if already
@@ -80,7 +132,8 @@ def main() -> int:
     if args.gens < 1:
         parser.error("--gens must be >= 1")
 
-    metric = "es_policy_evals_per_sec"
+    metric = ("poet_policy_evals_per_sec" if args.poet
+              else "es_policy_evals_per_sec")
     fail_payload = {
         "metric": metric,
         "value": 0.0,
@@ -89,10 +142,7 @@ def main() -> int:
         "error": "accelerator backend initialization timed out",
     }
 
-    if args.platform:
-        os.environ["JAX_PLATFORMS"] = args.platform
-        if args.platform == "cpu":
-            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    _resolve_platform(args)
 
     watchdog = _watchdog(args.init_timeout, fail_payload,
                          fallback_cpu=not args.platform)

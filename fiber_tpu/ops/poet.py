@@ -11,11 +11,16 @@ device plane:
 * the transfer matrix (every agent evaluated on every environment) is one
   vmapped cross-product program — the all-pairs evaluation the reference
   farms out as a task grid becomes a single XLA launch;
-* environment mutation + minimal-criterion filtering run on host (tiny).
+* environment mutation + minimal-criterion filtering + novelty ranking
+  run on host (tiny).
 
 The algorithm follows the published POET loop (mutate → filter by minimal
-criterion → transfer → optimize); this is a compact implementation, not a
-feature-complete POET reproduction.
+criterion → rank by novelty against the archive → admit, evicting the
+oldest pair at capacity → transfer → optimize). Novelty is mean distance
+to the k nearest environments ever created (the archive), so the
+frontier keeps moving instead of resampling familiar physics — the role
+the reference's env_categorizer/novelty ranking plays in its POET
+example (examples/gecco-2020 reproduce/novelty flow).
 """
 
 from __future__ import annotations
@@ -57,6 +62,13 @@ class POET:
         # active population: lists of (env_params jax array, theta vector)
         self.envs: List = [jnp.asarray(env_cls.DEFAULT)]
         self.agents: List = [policy.init(jax.random.PRNGKey(0))]
+        # every env ever admitted (host numpy) — the novelty reference set;
+        # retired pairs stay here, so re-mutating toward old physics scores
+        # low forever.
+        import numpy as np
+
+        self.archive: List = [np.asarray(env_cls.DEFAULT, dtype=float)]
+        self.novelty_k = 3
         self._es = None  # one shared compiled ES step (lazy)
 
         def eval_pair(env_params, theta, key):
@@ -65,7 +77,10 @@ class POET:
                 max_steps=rollout_steps,
             )
 
-        self._eval_pair = eval_pair
+        # jitted: the minimal-criterion check runs every iteration once
+        # the novelty loop is active — traced-per-call rollouts would
+        # dominate the spawn phase.
+        self._eval_pair = jax.jit(eval_pair)
         # Transfer matrix: (n_env, n_agent) fitness in one program.
         self._cross = jax.jit(
             jax.vmap(          # over envs
@@ -147,20 +162,32 @@ class POET:
                 transfers += 1
         return transfers
 
-    def try_spawn_envs(self, key, n_candidates: int = 4) -> int:
-        """Mutate existing envs; admit candidates passing the minimal
+    def novelty(self, env_params) -> float:
+        """Mean distance to the k nearest environments in the archive
+        (published POET ranks children by novelty so admitted envs push
+        the frontier instead of clustering)."""
+        import numpy as np
+
+        cand = np.asarray(env_params, dtype=float)
+        dists = np.sort([
+            float(np.linalg.norm(cand - seen)) for seen in self.archive
+        ])
+        k = min(self.novelty_k, len(dists))
+        return float(np.mean(dists[:k]))
+
+    def try_spawn_envs(self, key, n_candidates: int = 4,
+                       max_admit: int = 2) -> int:
+        """Mutate existing envs; keep candidates passing the minimal
         criterion (not trivially easy, not impossibly hard for the
-        current best agents). Returns number admitted."""
+        current best agents), rank them by novelty against the archive,
+        and admit the most novel. At capacity, each admission retires
+        the OLDEST active pair (its env stays in the archive), keeping
+        the loop open-ended. Returns number admitted."""
         import jax
         import numpy as np
-        import jax.numpy as jnp
 
-        if len(self.envs) >= self.max_pairs:
-            return 0
-        admitted = 0
+        passed = []
         for _ in range(n_candidates):
-            if len(self.envs) >= self.max_pairs:
-                break
             key, mut_key, eval_key, pick = jax.random.split(key, 4)
             parent = int(jax.random.randint(pick, (), 0, len(self.envs)))
             cand = self.env_cls.mutate(self.envs[parent], mut_key)
@@ -169,9 +196,29 @@ class POET:
                 cand, self.agents[parent], eval_key
             )))
             if self.mc_low <= score <= self.mc_high:
-                self.envs.append(cand)
-                self.agents.append(self.agents[parent])
-                admitted += 1
+                # capture the parent AGENT itself — evictions below shift
+                # list indices, array references don't move
+                passed.append((self.agents[parent], cand))
+
+        admitted = 0
+        while passed and admitted < max_admit:
+            # Re-score against the archive AS IT GROWS: the first admit
+            # joins the reference set before the next pick, so two
+            # near-duplicate frontier candidates can't both get in.
+            scored = [(self.novelty(cand), i)
+                      for i, (_agent, cand) in enumerate(passed)]
+            best_novelty, best_i = max(scored)
+            if admitted > 0 and best_novelty == 0.0:
+                break  # exact duplicate of something already admitted
+            parent_agent, cand = passed.pop(best_i)
+            if len(self.envs) >= self.max_pairs:
+                # retire the oldest pair (list order = creation order)
+                self.envs.pop(0)
+                self.agents.pop(0)
+            self.envs.append(cand)
+            self.agents.append(parent_agent)
+            self.archive.append(np.asarray(cand, dtype=float))
+            admitted += 1
         return admitted
 
     # ------------------------------------------------------------------
@@ -194,6 +241,7 @@ class POET:
                 "mean_fitness": sum(means) / len(means),
                 "spawned": spawned,
                 "transfers": transfers,
+                "archive_size": len(self.archive),
             }
             history.append(record)
             if log:

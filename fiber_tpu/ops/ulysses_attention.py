@@ -1,0 +1,95 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence swap.
+
+The second long-context strategy next to ``ops/ring_attention``. Ring
+attention pipelines K/V blocks around the mesh with ``ppermute`` (memory
+scales with the local block; latency hides behind compute). The Ulysses
+layout instead runs TWO ``all_to_all`` collectives: inputs arrive
+sequence-sharded, the first all-to-all redistributes them so each device
+holds the FULL sequence for ``heads / n_dev`` heads, attention runs
+locally and exactly (no online-softmax machinery), and the second
+all-to-all restores sequence sharding. On TPU both collectives ride ICI;
+for moderate sequence lengths this is usually faster than the ring
+because the matmuls stay as one large MXU-friendly batch per head.
+
+Trade-offs (why both exist):
+
+* ulysses needs ``heads % n_dev == 0`` and materializes the full
+  (seq, seq) score matrix per local head — memory grows with global
+  sequence length squared;
+* ring never materializes full scores and has no head-count constraint,
+  but pays the online-softmax rescaling and a ppermute chain.
+
+No counterpart exists in the reference (it has no model-parallel or
+sequence-parallel machinery at all — SURVEY.md §"Parallelism
+strategies"); this is part of the TPU-native long-context mandate.
+"""
+
+from __future__ import annotations
+
+# (mesh, axis, causal) -> jitted program. Same policy as ring_attention:
+# meshes hash by value, there are only ever a handful per process, so a
+# plain dict is the right cache.
+_compiled_cache: dict = {}
+
+
+def _build(mesh, axis: str, causal: bool):
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from fiber_tpu.ops.ring_attention import reference_attention
+
+    def local_fn(q, k, v):
+        # local shards: (seq/n, heads, head_dim)
+        # all-to-all #1: scatter heads, gather sequence ->
+        # (seq, heads/n, head_dim); every device now sees the whole
+        # sequence for its head slice.
+        def seq_to_heads(x):
+            return jax.lax.all_to_all(
+                x, axis, split_axis=1, concat_axis=0, tiled=True
+            )
+
+        qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+        out = reference_attention(qh, kh, vh, causal=causal)
+        # all-to-all #2: scatter sequence, gather heads — back to the
+        # input layout.
+        return jax.lax.all_to_all(
+            out, axis, split_axis=0, concat_axis=1, tiled=True
+        )
+
+    spec = P(axis)
+    return jax.jit(shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    ))
+
+
+def ulysses_attention(q, k, v, mesh=None, axis: str = "pool",
+                      causal: bool = False):
+    """Exact attention with the sequence dim sharded over ``axis``.
+
+    q, k, v: (seq, heads, head_dim); ``seq`` and ``heads`` must both
+    divide evenly by the mesh axis size. Returns (seq, heads, head_dim)
+    with the same sharding. Mesh keys hash by value, so the compiled
+    program is shared across equal meshes (no id-aliasing)."""
+    from fiber_tpu.parallel.mesh import default_mesh
+
+    mesh = mesh or default_mesh()
+    n_dev = mesh.shape[axis]
+    seq, heads = q.shape[0], q.shape[1]
+    if seq % n_dev:
+        raise ValueError(
+            f"seq {seq} must be divisible by the mesh axis size {n_dev}"
+        )
+    if heads % n_dev:
+        raise ValueError(
+            f"ulysses needs heads % n_dev == 0 (got {heads} heads over "
+            f"{n_dev} devices); use ring_attention for odd head counts"
+        )
+    key = (mesh, axis, causal)
+    fn = _compiled_cache.get(key)
+    if fn is None:
+        fn = _build(mesh, axis, causal)
+        _compiled_cache[key] = fn
+    return fn(q, k, v)

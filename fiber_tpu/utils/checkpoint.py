@@ -113,3 +113,33 @@ def load_es_state(path: str):
         int(state["generation"]),
         state.get("extra"),
     )
+
+
+def save_poet_state(path: str, poet, key, iteration: int) -> None:
+    """Checkpoint a :class:`fiber_tpu.ops.poet.POET` run: active pairs,
+    the novelty archive, and the RNG key — everything needed to resume
+    the co-evolution loop (long POET runs are the reference's flagship
+    workload; durable state there meant PVCs)."""
+    import numpy as np
+
+    save(path, {
+        "envs": list(poet.envs),
+        "agents": list(poet.agents),
+        "archive": list(poet.archive),
+        "key": key,
+        "iteration": np.asarray(iteration),
+    })
+
+
+def load_poet_state(path: str, poet):
+    """Restore state saved by :func:`save_poet_state` into ``poet``
+    (constructed with the same env_cls/policy/shapes). Returns
+    (key, iteration)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    state = load(path)
+    poet.envs = [jnp.asarray(e) for e in state["envs"]]
+    poet.agents = [jnp.asarray(a) for a in state["agents"]]
+    poet.archive = [np.asarray(a, dtype=float) for a in state["archive"]]
+    return state["key"], int(state["iteration"])

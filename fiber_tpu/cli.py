@@ -16,6 +16,7 @@ agent          run the per-host agent daemon (started on every TPU-VM)
 up             print (or execute) the commands that start agents on every
                host of a pod slice via gcloud ssh
 status         ping every host agent and report liveness/host info
+logs           fetch a job's log tail by jid (host:port/jobid)
 cp             stage files to/from hosts through the agents
 =============  ==========================================================
 """
@@ -239,6 +240,29 @@ def cmd_status(args) -> int:
     return rc
 
 
+def cmd_logs(args) -> int:
+    """Fetch a job's log tail by its jid (``host:port/jid`` — as printed
+    by ``run --submit`` and carried by ``Process.job.jid``)."""
+    from fiber_tpu.backends.tpu import AgentClient
+
+    if "/" not in args.jid:
+        raise SystemExit("error: jid must look like host:port/jobid")
+    addr, _, jid_s = args.jid.rpartition("/")
+    host, _, port_s = addr.rpartition(":")
+    if not host or not port_s.isdigit() or not jid_s.isdigit():
+        raise SystemExit("error: jid must look like host:port/jobid")
+    if args.bytes <= 0:
+        raise SystemExit("error: --bytes must be positive")
+    client = AgentClient(host, int(port_s))
+    try:
+        sys.stdout.write(client.call("logs", int(jid_s), args.bytes))
+    except Exception as err:
+        raise SystemExit(f"error: {err}") from None
+    finally:
+        client.close()
+    return 0
+
+
 def cmd_cp(args) -> int:
     """Stage files: local -> all hosts, or host:path -> local.
 
@@ -317,6 +341,11 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("status", help="ping every host agent")
     p.add_argument("--hosts", default="")
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("logs", help="fetch a job's log tail by jid")
+    p.add_argument("jid", help="host:port/jobid (as printed by --submit)")
+    p.add_argument("--bytes", type=int, default=65536)
+    p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser("cp", help="stage files to/from hosts")
     p.add_argument("src")

@@ -2,4 +2,10 @@
 networks and pure-JAX environments whose rollouts compile end-to-end."""
 
 from fiber_tpu.models.policies import MLPPolicy, ConvPolicy  # noqa: F401
-from fiber_tpu.models.envs import CartPole, Pendulum  # noqa: F401
+from fiber_tpu.models.envs import (  # noqa: F401
+    CartPole,
+    ParamCartPole,
+    ParamHillWalker,
+    Pendulum,
+    PixelChase,
+)

@@ -316,3 +316,89 @@ def _angle_normalize(x):
     import jax.numpy as jnp
 
     return ((x + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+
+
+class ParamHillWalker:
+    """Terrain-parameterized 1-D walker — the POET paper's co-evolution
+    shape (the reference's gecco-2020 example evolves BipedalWalker
+    terrains; this is that substrate as compiled XLA: the terrain IS the
+    evolvable environment).
+
+    A point mass drives along a height field
+    ``h(x) = Σ aᵢ·sin(fᵢ·x)`` whose amplitude vector ``aᵢ`` is the
+    environment's parameter vector. Observations are local terrain
+    perception (velocity + slope at/ahead of the agent) — translation
+    invariant, so agents generalize across terrains the way POET needs.
+    Fitness is distance travelled; steeper evolved terrain = harder env.
+    """
+
+    obs_dim = 4
+    act_dim = 3  # push back / coast / push forward
+    max_steps = 200
+
+    dt = 0.05
+    friction = 0.5
+    force_mag = 4.0
+    gravity = 9.8
+
+    #: fixed incommensurate bump frequencies; env params are amplitudes
+    FREQS = (0.5, 0.9, 1.4, 2.1, 3.1, 4.3)
+    DEFAULT = (0.0, 0.0, 0.0, 0.0, 0.0, 0.0)  # flat ground
+    PARAM_LOW = (-1.2,) * 6
+    PARAM_HIGH = (1.2,) * 6
+
+    @classmethod
+    def slope(cls, env_params, x):
+        """dh/dx at position x (analytic — no finite differences)."""
+        import jax.numpy as jnp
+
+        freqs = jnp.asarray(cls.FREQS)
+        amps = jnp.asarray(env_params)
+        return jnp.sum(amps * freqs * jnp.cos(freqs * x))
+
+    @classmethod
+    def rollout_p(cls, act_fn, env_params, flat_params, key,
+                  max_steps: int | None = None):
+        """Distance travelled under a specific terrain; jittable and
+        vmappable over (env_params, flat_params) pairs — same contract
+        as ParamCartPole.rollout_p."""
+        import jax
+        import jax.numpy as jnp
+
+        steps = max_steps or cls.max_steps
+        x0 = 0.1 * jax.random.normal(key, ())
+        v0 = jnp.asarray(0.0)
+
+        def scan_step(carry, _):
+            x, v = carry
+            obs = jnp.stack([
+                v,
+                cls.slope(env_params, x),
+                cls.slope(env_params, x + 0.5),
+                cls.slope(env_params, x + 1.0),
+            ])
+            action = act_fn(flat_params, obs)
+            force = (action.astype(jnp.float32) - 1.0) * cls.force_mag
+            acc = force - cls.gravity * cls.slope(env_params, x) \
+                - cls.friction * v
+            v = v + cls.dt * acc
+            x = x + cls.dt * v
+            return (x, v), None
+
+        (x, _v), _ = jax.lax.scan(
+            scan_step, (x0, v0), None, length=steps
+        )
+        return x
+
+    @classmethod
+    def mutate(cls, env_params, key, scale: float = 0.15):
+        """Perturb the terrain amplitudes within bounds (POET env
+        mutation)."""
+        import jax
+        import jax.numpy as jnp
+
+        low = jnp.asarray(cls.PARAM_LOW)
+        high = jnp.asarray(cls.PARAM_HIGH)
+        noise = jax.random.normal(key, (len(cls.FREQS),)) \
+            * scale * (high - low)
+        return jnp.clip(jnp.asarray(env_params) + noise, low, high)

@@ -59,6 +59,8 @@ class POET:
         self.mc_high = mc_high if mc_high is not None else rollout_steps * 0.9
         self.mesh = mesh
 
+        #: environment parameter dimensionality (physics/terrain vector)
+        self.env_dim = len(env_cls.DEFAULT)
         # active population: lists of (env_params jax array, theta vector)
         self.envs: List = [jnp.asarray(env_cls.DEFAULT)]
         self.agents: List = [policy.init(jax.random.PRNGKey(0))]
@@ -104,7 +106,7 @@ class POET:
 
             self._es = EvolutionStrategy(
                 eval_fn,
-                dim=self.policy.dim + 4,
+                dim=self.policy.dim + self.env_dim,
                 pop_size=self.pop_size,
                 sigma=self.sigma,
                 lr=self.lr,

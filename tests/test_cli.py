@@ -243,3 +243,44 @@ def env_get_pythonpath():
     import os
 
     return os.environ.get("PYTHONPATH", "")
+
+
+def test_logs_fetches_job_tail():
+    """fiber-tpu logs host:port/jid prints the job's log tail."""
+    import sys
+    import threading
+    import time
+
+    import pytest as _pytest
+
+    from fiber_tpu.backends.tpu import AgentClient
+    from fiber_tpu.cli import main
+    from fiber_tpu.host_agent import HostAgent
+
+    agent = HostAgent(0, bind="127.0.0.1")
+    threading.Thread(target=agent.serve_forever, daemon=True).start()
+    client = AgentClient("127.0.0.1", agent.port)
+    try:
+        jid, _ = client.call(
+            "spawn", [sys.executable, "-c", "print('log-line-42')"],
+            None, {}, "logjob", None,
+        )
+        client.call("wait", jid, 10)
+        time.sleep(0.1)
+        import io
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = main(["logs", f"127.0.0.1:{agent.port}/{jid}"])
+        assert rc == 0
+        assert "log-line-42" in buf.getvalue()
+
+        with _pytest.raises(SystemExit, match="jid must look like"):
+            main(["logs", "nonsense"])
+    finally:
+        client.close()
+        try:
+            agent._listener.close()
+        except OSError:
+            pass

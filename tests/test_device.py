@@ -484,3 +484,44 @@ def test_ulysses_attention_matches_reference():
             jax.random.normal(kk, (64, 4, 16)),
             jax.random.normal(kv, (64, 4, 16)),
         )
+
+
+def test_param_hill_walker_physics_and_poet():
+    """Terrain co-evolution substrate: flat ground is easier than steep
+    terrain for the same agent, rollouts jit, and POET co-evolves on it
+    (the POET paper's evolvable-terrain shape)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fiber_tpu.models.envs import ParamHillWalker
+    from fiber_tpu.ops.poet import POET
+
+    policy = MLPPolicy(ParamHillWalker.obs_dim, ParamHillWalker.act_dim,
+                       hidden=(8,))
+
+    # a constant push-forward agent travels further on flat ground than
+    # over steep hills
+    def push_forward(_params, _obs):
+        return jnp.asarray(2)
+
+    key = jax.random.PRNGKey(0)
+    flat = jax.jit(
+        lambda k: ParamHillWalker.rollout_p(
+            push_forward, jnp.asarray(ParamHillWalker.DEFAULT),
+            policy.init(key), k, max_steps=150,
+        )
+    )(key)
+    steep = jax.jit(
+        lambda k: ParamHillWalker.rollout_p(
+            push_forward, jnp.asarray(ParamHillWalker.PARAM_HIGH),
+            policy.init(key), k, max_steps=150,
+        )
+    )(key)
+    assert float(flat) > float(steep), (float(flat), float(steep))
+    assert float(flat) > 1.0  # actually makes progress
+
+    poet = POET(ParamHillWalker, policy, pop_size=32, max_pairs=3,
+                rollout_steps=80, mc_low=0.2, mc_high=50.0)
+    history = poet.run(jax.random.PRNGKey(1), iterations=2, es_steps=2)
+    assert np.isfinite(history[-1]["mean_fitness"])
+    assert history[-1]["pairs"] >= 1

@@ -26,8 +26,10 @@ from __future__ import annotations
 
 import hashlib
 import itertools
+from collections import deque
 import os
 import queue as pyqueue
+import sys
 import threading
 import time
 import traceback
@@ -339,6 +341,35 @@ def _run_chunk(fn: Callable, chunk: List[Any], star: bool) -> List[Any]:
     return out
 
 
+# Exit codes a packed sub-worker uses so the packing parent can tell a
+# clean maxtasksperchild recycle (17) and a transport failure (19) apart
+# from "the pool is shutting down" (0) and from a crash (anything else).
+_SUBWORKER_RECYCLE = 17
+_SUBWORKER_XPORT_ERR = 19
+
+
+def _subworker_main(
+    ident: bytes,
+    task_addr: str,
+    result_addr: str,
+    resilient: bool,
+    initializer: Optional[Callable],
+    initargs: Tuple,
+    maxtasksperchild: Optional[int],
+) -> None:
+    reason = _pool_worker_core(
+        task_addr, result_addr, resilient, initializer, initargs,
+        maxtasksperchild, ident=ident,
+    )
+    if reason == "recycle":
+        sys.exit(_SUBWORKER_RECYCLE)
+    if reason == "error":
+        # A dropped connection is NOT a drain: the parent must report the
+        # ident (its handed-out chunk may be stranded in the pending
+        # table) and respawn — exit 0 here would silently eat both.
+        sys.exit(_SUBWORKER_XPORT_ERR)
+
+
 def pool_worker(
     task_addr: str,
     result_addr: str,
@@ -350,25 +381,88 @@ def pool_worker(
 ) -> None:
     """Body of one pool worker process. With ``n_local > 1`` the process
     packs that many OS sub-workers, each dialing the master independently
-    (reference: fiber/pool.py:144-173 cpu_per_job packing)."""
+    (reference: fiber/pool.py:144-173 cpu_per_job packing).
+
+    Unlike the reference — where a dead sub-worker's pending chunks
+    strand until the WHOLE job exits (job-level ``is_alive`` is the only
+    death signal) — the packing parent here monitors each child: a crash
+    is reported to the master as a ``("subdead", ident)`` control frame
+    on the result channel (the ResilientPool resubmits exactly that
+    sub-worker's pending chunks) and the child is respawned in place, so
+    the job never silently loses capacity. Clean maxtasksperchild
+    recycling (exit code ``_SUBWORKER_RECYCLE``) respawns without a
+    death report; exit 0 means the pool is draining — no respawn."""
     if n_local > 1:
         import multiprocessing
 
+        from fiber_tpu.transport.tcp import connect_transport
+
         ctx = multiprocessing.get_context("fork")
-        children = [
-            ctx.Process(
-                target=_pool_worker_core,
-                args=(task_addr, result_addr, resilient, initializer,
-                      initargs, maxtasksperchild),
+
+        def spawn(i: int):
+            ident = uuid.uuid4().bytes
+            c = ctx.Process(
+                target=_subworker_main,
+                args=(ident, task_addr, result_addr, resilient,
+                      initializer, initargs, maxtasksperchild),
                 name=f"fiber-subworker-{i}",
                 daemon=True,
             )
-            for i in range(n_local)
-        ]
-        for c in children:
             c.start()
-        for c in children:
-            c.join()
+            return ident, c
+
+        def report(kind: str, ident: bytes) -> None:
+            # One short-lived connection per (rare) report: a persistent
+            # control connection would inflate the result endpoint's peer
+            # count, which wait_workers() reads as "workers connected".
+            try:
+                ep = connect_transport("w", result_addr)
+                try:
+                    ep.send(serialization.dumps((kind, ident)))
+                finally:
+                    ep.close()
+            except Exception:
+                logger.exception("subworker monitor: %s report failed", kind)
+
+        children = {ident: (c, time.monotonic())
+                    for ident, c in (spawn(i) for i in range(n_local))}
+        draining = False
+        fail_streak = 0
+        while children:
+            time.sleep(0.1)
+            for ident, (c, born) in list(children.items()):
+                code = c.exitcode
+                if code is None:
+                    continue
+                del children[ident]
+                c.join()
+                if code == 0:
+                    draining = True  # master released this worker
+                    continue
+                if code == _SUBWORKER_RECYCLE:
+                    # Clean recycle: let the master drop the old ident's
+                    # (empty) bookkeeping so a long-lived pool doesn't
+                    # accumulate one entry per retirement.
+                    report("subgone", ident)
+                else:
+                    # Crash or transport failure: the master must
+                    # resubmit this sub-worker's pending chunks NOW
+                    # rather than when the whole job dies.
+                    report("subdead", ident)
+                if draining:
+                    continue
+                if code != _SUBWORKER_RECYCLE:
+                    # Exponential backoff on rapid crash loops (failing
+                    # initializer, master gone hard): a child that died
+                    # within 5s of spawn escalates the delay, a child
+                    # that survived longer resets it.
+                    if time.monotonic() - born < 5.0:
+                        fail_streak += 1
+                    else:
+                        fail_streak = 0
+                    time.sleep(min(0.1 * (2 ** fail_streak), 5.0))
+                new_ident, new_c = spawn(len(children))
+                children[new_ident] = (new_c, time.monotonic())
         return
     _pool_worker_core(
         task_addr, result_addr, resilient, initializer, initargs,
@@ -383,13 +477,14 @@ def _pool_worker_core(
     initializer: Optional[Callable],
     initargs: Tuple,
     maxtasksperchild: Optional[int],
-) -> None:
+    ident: Optional[bytes] = None,
+) -> str:
     from fiber_tpu import process as fprocess
 
     if initializer is not None:
         initializer(*initargs)
 
-    ident = uuid.uuid4().bytes
+    ident = ident or uuid.uuid4().bytes
     fiber_pid = fprocess.current_process().pid or os.getpid()
     funcs = _FuncCache()
 
@@ -402,6 +497,7 @@ def _pool_worker_core(
         task_ep = connect_transport("r", task_addr)
 
     completed_chunks = 0
+    reason = "error"
     try:
         while True:
             if resilient:
@@ -411,6 +507,7 @@ def _pool_worker_core(
                 data = task_ep.recv()
             msg = serialization.loads(data)
             if msg[0] == "exit":
+                reason = "exit"
                 break
             _, seq, base, digest, blob, chunk, star = msg
             fn = funcs.get(digest, blob)
@@ -420,12 +517,14 @@ def _pool_worker_core(
             )
             completed_chunks += 1
             if maxtasksperchild and completed_chunks >= maxtasksperchild:
+                reason = "recycle"
                 break
     except (TransportClosed, OSError):
         pass  # master went away; the watchdog handles hard exits
     finally:
         task_ep.close()
         result_ep.close()
+    return reason
 
 
 # ---------------------------------------------------------------------------
@@ -651,6 +750,16 @@ class Pool:
             # hangs every outstanding .get() (advisor, round 1).
             try:
                 msg = serialization.loads(data)
+                if msg[0] == "subdead":
+                    # A packing parent reporting one crashed sub-worker
+                    # (job still alive — resubmit only that ident).
+                    self._on_subworker_death(msg[1])
+                    continue
+                if msg[0] == "subgone":
+                    # Clean maxtasksperchild retirement: drop the ident's
+                    # bookkeeping so long-lived pools don't accumulate it.
+                    self._on_subworker_gone(msg[1])
+                    continue
                 if msg[0] != "result":
                     continue
                 _, seq, base, values, ident = msg
@@ -660,6 +769,12 @@ class Pool:
                 logger.exception("pool: dropping malformed result frame")
 
     def _on_result(self, seq, base, values, ident) -> None:
+        pass
+
+    def _on_subworker_death(self, ident: bytes) -> None:
+        pass
+
+    def _on_subworker_gone(self, ident: bytes) -> None:
         pass
 
     # -- submission --------------------------------------------------------
@@ -949,8 +1064,23 @@ class ResilientPool(Pool):
         self._pending: Dict[bytes, Dict[Tuple[int, int], Tuple[bytes, int]]] = {}
         self._pid_to_idents: Dict[int, set] = {}
         self._reaped_pids: set = set()
+        # Dead-ident guard against stale "ready"s queued before a
+        # sub-worker's death was processed. The window is short, so the
+        # set is bounded: oldest entries fall out once the deque is full
+        # (a long-lived die-heavy pool must not leak one entry per crash).
+        self._dead_idents: set = set()
+        self._dead_idents_order: "deque[bytes]" = deque(maxlen=4096)
         self._pending_lock = threading.Lock()
         super().__init__(*args, **kwargs)
+
+    def _mark_ident_dead(self, ident: bytes) -> None:
+        # Caller holds _pending_lock.
+        if ident in self._dead_idents:
+            return
+        if len(self._dead_idents_order) == self._dead_idents_order.maxlen:
+            self._dead_idents.discard(self._dead_idents_order[0])
+        self._dead_idents_order.append(ident)
+        self._dead_idents.add(ident)
 
     # Task handout: answer each worker's "ready" request with a task and
     # record it in the pending table until its result arrives.
@@ -974,9 +1104,11 @@ class ResilientPool(Pool):
             _, ident, fiber_pid = msg
             # A stale "ready" from a worker that was already reaped must
             # not receive (and thereby strand) a task: its pending table is
-            # gone and nobody would ever resubmit the chunk.
+            # gone and nobody would ever resubmit the chunk. Same for an
+            # ident whose sub-worker death was already processed.
             with self._pending_lock:
-                stale = fiber_pid in self._reaped_pids
+                stale = (fiber_pid in self._reaped_pids
+                         or ident in self._dead_idents)
             if stale:
                 try:
                     self._task_ep.send(serialization.dumps(_EXIT))
@@ -1011,7 +1143,8 @@ class ResilientPool(Pool):
                 # The worker may have been reaped while we waited for a
                 # task — its pending table is gone and nobody would ever
                 # resubmit this chunk. Requeue for the next "ready".
-                if fiber_pid in self._reaped_pids:
+                if (fiber_pid in self._reaped_pids
+                        or ident in self._dead_idents):
                     self._taskq.put(item)
                     continue
                 self._pending.setdefault(ident, {})[key] = payload
@@ -1030,6 +1163,37 @@ class ResilientPool(Pool):
             table = self._pending.get(ident)
             if table is not None:
                 table.pop((seq, base), None)
+
+    def _reclaim_ident(self, ident: bytes) -> int:
+        """Retire one sub-worker ident: block future handouts to it, drop
+        its bookkeeping, and requeue whatever it still owed. Returns the
+        number of chunks resubmitted. Duplicate executions this can cause
+        are safe: resilient-pool tasks must be idempotent and duplicate
+        results are deduped by ResultStore.fill."""
+        with self._pending_lock:
+            self._mark_ident_dead(ident)
+            table = self._pending.pop(ident, {})
+            for idents in self._pid_to_idents.values():
+                idents.discard(ident)
+            resubmit = [(payload, key) for key, payload in table.items()]
+        for payload, key in resubmit:
+            self._taskq.put((payload, key))
+        return len(resubmit)
+
+    def _on_subworker_death(self, ident: bytes) -> None:
+        """Resubmit one crashed sub-worker's pending chunks while its job
+        keeps running (finer-grained than the reference, whose blast
+        radius with cpu_per_job>1 is the whole job: fiber/pool.py:1612-1659
+        only fires on job death). The packing parent respawns the
+        sub-worker in place, so capacity is repaired too."""
+        n = self._reclaim_ident(ident)
+        if n:
+            logger.info("resubmitted %d chunks from dead sub-worker", n)
+
+    def _on_subworker_gone(self, ident: bytes) -> None:
+        """A packed sub-worker retired cleanly (maxtasksperchild): drop its
+        bookkeeping (normally empty; a crash-at-exit loses nothing)."""
+        self._reclaim_ident(ident)
 
     def _on_worker_death(self, proc) -> None:
         """Resubmit everything the dead worker still owed

@@ -122,19 +122,25 @@ def read_initialized(_):
     return _POOL_INIT_VALUE
 
 
-def die_once_marker(x):
-    """Task 7 hard-kills its worker the first time it runs (marker file
-    prevents the retry from dying again) — exercises resubmission."""
+def _die_once(x, trigger, marker_name):
+    """Hard-kill the worker the first time ``x == trigger`` runs; the
+    marker file keeps the resubmitted retry alive — exercises
+    resubmission. One body shared by every die-once target so the crash
+    simulation can't drift between tests."""
     import os
     import tempfile
 
-    if x == 7:
-        marker = os.path.join(tempfile.gettempdir(), "fiber_die_once_marker")
+    if x == trigger:
+        marker = os.path.join(tempfile.gettempdir(), marker_name)
         if not os.path.exists(marker):
             with open(marker, "w") as fh:
                 fh.write("died")
             os._exit(42)
     return x
+
+
+def die_once_marker(x):
+    return _die_once(x, 7, "fiber_die_once_marker")
 
 
 def pi_inside(n):
@@ -276,3 +282,9 @@ def jax_distributed_psum_check(rank, size):
     expected = n * (n - 1) / 2  # sum over the global arange
     assert float(local.ravel()[0]) == expected, (local, expected)
     jax.distributed.shutdown()
+
+
+def die_once_sub(x):
+    """die_once_marker with its own marker file — used by the
+    cpu_per_job packing tests so the two tests can't interfere."""
+    return _die_once(x, 5, "fiber_die_once_sub")

@@ -111,6 +111,49 @@ def test_resilient_resubmission_on_worker_death():
         assert sorted(results) == sorted(range(30))
 
 
+def test_subworker_death_resubmits_without_job_death():
+    """With cpu_per_job>1 a crashed sub-worker must NOT strand its pending
+    chunks until the whole job dies (the reference's blast radius,
+    fiber/pool.py:1612-1659 fires only on job death): the packing parent
+    reports the dead ident, the master resubmits immediately, and the
+    sub-worker is respawned in place — the job never exits."""
+    import os
+    import tempfile
+
+    marker = os.path.join(tempfile.gettempdir(), "fiber_die_once_sub")
+    if os.path.exists(marker):
+        os.remove(marker)
+    fiber_tpu.init(cpu_per_job=2)
+    try:
+        with fiber_tpu.Pool(2) as pool:
+            results = pool.map(targets.die_once_sub, range(30), chunksize=1)
+            assert sorted(results) == sorted(range(30))
+            # One packed job carrying both sub-workers, still alive: the
+            # crash was absorbed below the job level.
+            with pool._workers_lock:
+                workers = list(pool._workers)
+            assert len(workers) == 1
+            assert workers[0].is_alive()
+    finally:
+        fiber_tpu.init(cpu_per_job=1)
+        if os.path.exists(marker):
+            os.remove(marker)
+
+
+def test_maxtasksperchild_with_packing():
+    """maxtasksperchild recycling must work inside a packed job too: the
+    parent respawns a sub-worker that exits on its task budget (exit code
+    distinguishes recycle from drain), so the map completes at full
+    capacity instead of starving as sub-workers retire."""
+    fiber_tpu.init(cpu_per_job=2)
+    try:
+        with fiber_tpu.Pool(2, maxtasksperchild=2) as pool:
+            results = pool.map(targets.square, range(60), chunksize=2)
+            assert results == [i * i for i in range(60)]
+    finally:
+        fiber_tpu.init(cpu_per_job=1)
+
+
 def test_non_resilient_pool():
     with fiber_tpu.Pool(2, error_handling=False) as pool:
         assert pool.map(targets.square, range(20)) == [

@@ -226,7 +226,12 @@ class NativeClient:
         self._op_lock = threading.Lock()
         self._closed = False
 
-    def send(self, payload: bytes) -> None:
+    def send(self, payload: bytes,
+             timeout: Optional[float] = None) -> None:
+        # ``timeout`` is accepted for signature parity with
+        # Endpoint.send; the native path already fails fast (nq_send
+        # returns nonzero the moment the peer closes) rather than
+        # blocking indefinitely, so no deadline plumbing is needed.
         with self._op_lock:
             if self._closed:
                 raise OSError("connection closed")

@@ -378,6 +378,7 @@ def pool_worker(
     initargs: Tuple,
     maxtasksperchild: Optional[int],
     n_local: int = 1,
+    ctl_addr: Optional[str] = None,
 ) -> None:
     """Body of one pool worker process. With ``n_local > 1`` the process
     packs that many OS sub-workers, each dialing the master independently
@@ -386,12 +387,13 @@ def pool_worker(
     Unlike the reference — where a dead sub-worker's pending chunks
     strand until the WHOLE job exits (job-level ``is_alive`` is the only
     death signal) — the packing parent here monitors each child: a crash
-    is reported to the master as a ``("subdead", ident)`` control frame
-    on the result channel (the ResilientPool resubmits exactly that
+    is reported to the resilient master's dedicated control endpoint as
+    a ``("subdead", ident)`` frame (the master resubmits exactly that
     sub-worker's pending chunks) and the child is respawned in place, so
     the job never silently loses capacity. Clean maxtasksperchild
-    recycling (exit code ``_SUBWORKER_RECYCLE``) respawns without a
-    death report; exit 0 means the pool is draining — no respawn."""
+    recycling (exit code ``_SUBWORKER_RECYCLE``) respawns the slot and
+    reports ``("subgone", ident)`` so the master can retire the old
+    ident's bookkeeping; exit 0 means the pool is draining — no respawn."""
     if n_local > 1:
         import multiprocessing
 
@@ -411,25 +413,52 @@ def pool_worker(
             c.start()
             return ident, c
 
-        def report(kind: str, ident: bytes) -> None:
-            # One short-lived connection per (rare) report: a persistent
-            # control connection would inflate the result endpoint's peer
-            # count, which wait_workers() reads as "workers connected".
+        def try_report(kind: str, ident: bytes) -> bool:
+            # Reports ride the resilient master's DEDICATED control
+            # endpoint (ctl_addr; None on the plain pool, which has no
+            # pending table to repair). Not the result channel — that
+            # would inflate the peer count wait_workers() reads as
+            # "workers connected" — and not the REQ/REP task channel,
+            # whose single-threaded loop can be parked in its
+            # task-handout wait (a deadlock: resubmission needs the
+            # report processed, the report waits behind the handout).
+            # The credit-based send IS the delivery confirmation (it
+            # only completes against a consumer-granted credit); a
+            # failed send stays queued and is retried — a lost report
+            # must not strand the dead sub-worker's pending chunks
+            # forever, because the respawned slot keeps the job alive,
+            # so the job-death backstop would never fire.
             try:
-                ep = connect_transport("w", result_addr)
+                ep = connect_transport("w", ctl_addr)
                 try:
-                    ep.send(serialization.dumps((kind, ident)))
+                    # Bounded: a send into a half-dead connection must
+                    # fail (and be retried) rather than freeze the
+                    # monitor loop — this is the parent's only thread.
+                    ep.send(serialization.dumps((kind, ident)),
+                            timeout=10.0)
+                    return True
                 finally:
                     ep.close()
             except Exception:
-                logger.exception("subworker monitor: %s report failed", kind)
+                logger.warning("subworker monitor: %s report failed "
+                               "(will retry)", kind)
+                return False
 
         children = {ident: (c, time.monotonic())
                     for ident, c in (spawn(i) for i in range(n_local))}
         draining = False
         fail_streak = 0
+        pending_reports: List[Tuple[str, bytes]] = []
+        last_report_attempt = 0.0
         while children:
             time.sleep(0.1)
+            if pending_reports and ctl_addr \
+                    and time.monotonic() - last_report_attempt >= 1.0:
+                last_report_attempt = time.monotonic()
+                pending_reports = [
+                    (kind, ident) for kind, ident in pending_reports
+                    if not try_report(kind, ident)
+                ]
             for ident, (c, born) in list(children.items()):
                 code = c.exitcode
                 if code is None:
@@ -439,16 +468,15 @@ def pool_worker(
                 if code == 0:
                     draining = True  # master released this worker
                     continue
-                if code == _SUBWORKER_RECYCLE:
-                    # Clean recycle: let the master drop the old ident's
-                    # (empty) bookkeeping so a long-lived pool doesn't
-                    # accumulate one entry per retirement.
-                    report("subgone", ident)
-                else:
-                    # Crash or transport failure: the master must
-                    # resubmit this sub-worker's pending chunks NOW
-                    # rather than when the whole job dies.
-                    report("subdead", ident)
+                if ctl_addr:
+                    # Clean recycle ("subgone"): master drops the old
+                    # ident's bookkeeping. Crash ("subdead"): master
+                    # resubmits the ident's pending chunks NOW rather
+                    # than when the whole job dies.
+                    kind = ("subgone" if code == _SUBWORKER_RECYCLE
+                            else "subdead")
+                    pending_reports.append((kind, ident))
+                    last_report_attempt = 0.0
                 if draining:
                     continue
                 if code != _SUBWORKER_RECYCLE:
@@ -463,6 +491,9 @@ def pool_worker(
                     time.sleep(min(0.1 * (2 ** fail_streak), 5.0))
                 new_ident, new_c = spawn(len(children))
                 children[new_ident] = (new_c, time.monotonic())
+        # Final flush so a crash right at drain time still gets reported.
+        for kind, ident in pending_reports:
+            try_report(kind, ident)
         return
     _pool_worker_core(
         task_addr, result_addr, resilient, initializer, initargs,
@@ -626,6 +657,7 @@ class Pool:
                 self._initargs,
                 self._maxtasksperchild,
                 n_local,
+                getattr(self, "_ctl_addr", None),
             ),
             name=f"PoolWorker-{uuid.uuid4().hex[:8]}",
             daemon=True,
@@ -750,16 +782,6 @@ class Pool:
             # hangs every outstanding .get() (advisor, round 1).
             try:
                 msg = serialization.loads(data)
-                if msg[0] == "subdead":
-                    # A packing parent reporting one crashed sub-worker
-                    # (job still alive — resubmit only that ident).
-                    self._on_subworker_death(msg[1])
-                    continue
-                if msg[0] == "subgone":
-                    # Clean maxtasksperchild retirement: drop the ident's
-                    # bookkeeping so long-lived pools don't accumulate it.
-                    self._on_subworker_gone(msg[1])
-                    continue
                 if msg[0] != "result":
                     continue
                 _, seq, base, values, ident = msg
@@ -769,12 +791,6 @@ class Pool:
                 logger.exception("pool: dropping malformed result frame")
 
     def _on_result(self, seq, base, values, ident) -> None:
-        pass
-
-    def _on_subworker_death(self, ident: bytes) -> None:
-        pass
-
-    def _on_subworker_gone(self, ident: bytes) -> None:
         pass
 
     # -- submission --------------------------------------------------------
@@ -1072,6 +1088,40 @@ class ResilientPool(Pool):
         self._dead_idents_order: "deque[bytes]" = deque(maxlen=4096)
         self._pending_lock = threading.Lock()
         super().__init__(*args, **kwargs)
+        # Dedicated control endpoint for packing-parent sub-worker
+        # reports. Deliberately NOT the result endpoint (its peer count
+        # is what wait_workers() reads as "workers connected") and NOT
+        # the REQ/REP task endpoint (its single-threaded loop parks in
+        # the task-handout wait, which would deadlock against a
+        # resubmission-bearing report).
+        from fiber_tpu.backends import get_backend
+
+        ip, _, _ = get_backend().get_listen_addr()
+        self._ctl_ep = Endpoint("r")
+        self._ctl_addr = self._ctl_ep.bind(ip)
+        self._ctl_thread = threading.Thread(
+            target=self._ctl_loop, name="fiber-pool-ctl", daemon=True
+        )
+        self._ctl_thread.start()
+
+    def _ctl_loop(self) -> None:
+        while True:
+            try:
+                data = self._ctl_ep.recv()
+            except (TransportClosed, OSError):
+                return
+            try:
+                msg = serialization.loads(data)
+                if msg[0] == "subdead":
+                    self._on_subworker_death(msg[1])
+                elif msg[0] == "subgone":
+                    self._on_subworker_gone(msg[1])
+            except Exception:
+                logger.exception("pool: dropping malformed control frame")
+
+    def _shutdown_transport(self) -> None:
+        super()._shutdown_transport()
+        self._ctl_ep.close()
 
     def _mark_ident_dead(self, ident: bytes) -> None:
         # Caller holds _pending_lock.

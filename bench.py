@@ -268,28 +268,40 @@ _TPU_RECORD_PATH = os.path.join(
 )
 
 
+def _load_tpu_records() -> dict:
+    """Recorded TPU runs keyed by metric. Tolerates the flat single-run
+    layout older writers (and the round harness) produce."""
+    try:
+        with open(_TPU_RECORD_PATH) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    if "metric" in data:  # flat single-run file
+        return {data["metric"]: data}
+    return data
+
+
 def _record_or_attach_tpu_run(result: dict, wedged: bool) -> None:
-    """A run that lands on the real TPU records itself to
+    """A run that lands on the real TPU records itself (keyed by metric,
+    so ES and POET runs don't clobber each other) to
     RUNS/bench_tpu_success.json; a run that fell back to CPU because the
     tunnel was wedged (NOT an explicit ``--platform cpu`` request) rides
-    the recorded TPU result along — explicitly labeled — so a flaky
-    tunnel at harvest time doesn't erase the measured chip numbers."""
+    the recorded TPU result for its metric along — explicitly labeled —
+    so a flaky tunnel at harvest time doesn't erase the chip numbers."""
     if result.get("platform") == "tpu":
+        records = _load_tpu_records()
+        records[result["metric"]] = result
         try:
             os.makedirs(os.path.dirname(_TPU_RECORD_PATH), exist_ok=True)
             with open(_TPU_RECORD_PATH, "w") as fh:
-                json.dump(result, fh)
+                json.dump(records, fh)
         except OSError:
             pass
         return
     if not wedged:
         return
-    try:
-        with open(_TPU_RECORD_PATH) as fh:
-            recorded = json.load(fh)
-    except (OSError, ValueError):
-        return
-    if recorded.get("platform") == "tpu":
+    recorded = _load_tpu_records().get(result["metric"])
+    if recorded and recorded.get("platform") == "tpu":
         result["recorded_tpu_run"] = recorded
 
 
@@ -314,7 +326,7 @@ def _poet_bench(args, devices) -> int:
     total_evals = sum(h["pairs"] * poet.pop_size * es_steps
                       for h in history)
     per_chip_share = NORTH_STAR_EVALS_PER_SEC / NORTH_STAR_CHIPS
-    _emit({
+    result = {
         "metric": "poet_policy_evals_per_sec",
         "value": round(total_evals / elapsed, 2),
         "unit": "evals/s",
@@ -330,7 +342,9 @@ def _poet_bench(args, devices) -> int:
         "fitness_first_iter": round(history[0]["mean_fitness"], 2),
         "fitness_last_iter": round(history[-1]["mean_fitness"], 2),
         "history": history,
-    })
+    }
+    _record_or_attach_tpu_run(result, wedged=args.wedged_fallback)
+    _emit(result)
     return 0
 
 

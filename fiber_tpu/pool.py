@@ -429,11 +429,14 @@ def pool_worker(
             # forever, because the respawned slot keeps the job alive,
             # so the job-death backstop would never fire.
             try:
-                ep = connect_transport("w", ctl_addr)
+                # native=False: only the Python Endpoint honors the send
+                # deadline (the C client blocks on the credit wait); a
+                # report into a half-dead connection must fail (and be
+                # retried) rather than freeze the monitor loop — this is
+                # the parent's only thread. Reports are rare and tiny,
+                # so the native fast path buys nothing here.
+                ep = connect_transport("w", ctl_addr, native=False)
                 try:
-                    # Bounded: a send into a half-dead connection must
-                    # fail (and be retried) rather than freeze the
-                    # monitor loop — this is the parent's only thread.
                     ep.send(serialization.dumps((kind, ident)),
                             timeout=10.0)
                     return True
@@ -454,11 +457,13 @@ def pool_worker(
             time.sleep(0.1)
             if pending_reports and ctl_addr \
                     and time.monotonic() - last_report_attempt >= 1.0:
+                # ONE attempt per tick: with the master unreachable each
+                # attempt costs a full connect timeout, and burning it
+                # once per tick keeps the monitor reaping/respawning
+                # instead of starving in doomed connect() calls.
                 last_report_attempt = time.monotonic()
-                pending_reports = [
-                    (kind, ident) for kind, ident in pending_reports
-                    if not try_report(kind, ident)
-                ]
+                if try_report(*pending_reports[0]):
+                    pending_reports.pop(0)
             for ident, (c, born) in list(children.items()):
                 code = c.exitcode
                 if code is None:
@@ -472,10 +477,14 @@ def pool_worker(
                     # Clean recycle ("subgone"): master drops the old
                     # ident's bookkeeping. Crash ("subdead"): master
                     # resubmits the ident's pending chunks NOW rather
-                    # than when the whole job dies.
+                    # than when the whole job dies. Bounded queue: if
+                    # the master has been unreachable long enough to
+                    # accumulate this many reports, the pool is dead
+                    # anyway — dropping the oldest beats leaking.
                     kind = ("subgone" if code == _SUBWORKER_RECYCLE
                             else "subdead")
                     pending_reports.append((kind, ident))
+                    del pending_reports[:-256]
                     last_report_attempt = 0.0
                 if draining:
                     continue
@@ -491,9 +500,13 @@ def pool_worker(
                     time.sleep(min(0.1 * (2 ** fail_streak), 5.0))
                 new_ident, new_c = spawn(len(children))
                 children[new_ident] = (new_c, time.monotonic())
-        # Final flush so a crash right at drain time still gets reported.
+        # Final flush so a crash right at drain time still gets
+        # reported; stop at the first failure — an unreachable master
+        # must not hold the exiting parent for one connect timeout per
+        # queued report (job death is the backstop then anyway).
         for kind, ident in pending_reports:
-            try_report(kind, ident)
+            if not try_report(kind, ident):
+                break
         return
     _pool_worker_core(
         task_addr, result_addr, resilient, initializer, initargs,
@@ -1093,16 +1106,20 @@ class ResilientPool(Pool):
         # is what wait_workers() reads as "workers connected") and NOT
         # the REQ/REP task endpoint (its single-threaded loop parks in
         # the task-handout wait, which would deadlock against a
-        # resubmission-bearing report).
-        from fiber_tpu.backends import get_backend
+        # resubmission-bearing report). Only packed jobs ever report,
+        # so unpacked pools skip the listener + thread entirely.
+        self._ctl_ep = None
+        self._ctl_addr = None
+        if self._cpu_per_job > 1:
+            from fiber_tpu.backends import get_backend
 
-        ip, _, _ = get_backend().get_listen_addr()
-        self._ctl_ep = Endpoint("r")
-        self._ctl_addr = self._ctl_ep.bind(ip)
-        self._ctl_thread = threading.Thread(
-            target=self._ctl_loop, name="fiber-pool-ctl", daemon=True
-        )
-        self._ctl_thread.start()
+            ip, _, _ = get_backend().get_listen_addr()
+            self._ctl_ep = Endpoint("r")
+            self._ctl_addr = self._ctl_ep.bind(ip)
+            self._ctl_thread = threading.Thread(
+                target=self._ctl_loop, name="fiber-pool-ctl", daemon=True
+            )
+            self._ctl_thread.start()
 
     def _ctl_loop(self) -> None:
         while True:
@@ -1121,7 +1138,8 @@ class ResilientPool(Pool):
 
     def _shutdown_transport(self) -> None:
         super()._shutdown_transport()
-        self._ctl_ep.close()
+        if self._ctl_ep is not None:
+            self._ctl_ep.close()
 
     def _mark_ident_dead(self, ident: bytes) -> None:
         # Caller holds _pending_lock.

@@ -477,14 +477,19 @@ def parse_addr(addr: str) -> Tuple[str, int]:
 _NATIVE_MODE_MAP = {"r": "r", "w": "w", "rw": "rw", "req": "rw"}
 
 
-def connect_transport(mode: str, addr: str):
+def connect_transport(mode: str, addr: str, native: bool = True):
     """The one place that picks a connection-side transport: the native C
     client (framing + socket + credit protocol per ctypes call) when the
     library loads and the address is a numeric IPv4, else a Python
     Endpoint. Used by queue/pipe Connections and pool workers alike so
-    the selection policy can never diverge."""
+    the selection policy can never diverge.
+
+    ``native=False`` forces the Python Endpoint — for callers that need
+    honored send deadlines (the C client's send blocks on the credit
+    wait with no timeout plumbing; fine for the data path, wrong for
+    watchdog-style control sends that must never freeze)."""
     host, port = parse_addr(addr)
-    native_mode = _NATIVE_MODE_MAP.get(mode)
+    native_mode = _NATIVE_MODE_MAP.get(mode) if native else None
     if native_mode is not None and host.count(".") == 3 and \
             host.replace(".", "").isdigit():
         try:

@@ -1,11 +1,16 @@
 """Model zoo for the framework's population-based workloads: policy
 networks and pure-JAX environments whose rollouts compile end-to-end."""
 
-from fiber_tpu.models.policies import MLPPolicy, ConvPolicy  # noqa: F401
+from fiber_tpu.models.policies import (  # noqa: F401
+    ConvPolicy,
+    GRUPolicy,
+    MLPPolicy,
+)
 from fiber_tpu.models.envs import (  # noqa: F401
     CartPole,
     ParamCartPole,
     ParamHillWalker,
     Pendulum,
     PixelChase,
+    rollout_recurrent,
 )

@@ -402,3 +402,38 @@ class ParamHillWalker:
         noise = jax.random.normal(key, (len(cls.FREQS),)) \
             * scale * (high - low)
         return jnp.clip(jnp.asarray(env_params) + noise, low, high)
+
+
+def rollout_recurrent(env_cls, policy, flat_params, key,
+                      max_steps: int | None = None):
+    """Episode reward for a RECURRENT policy (``init_carry``/``act_step``
+    interface, e.g. GRUPolicy) on a CARTPOLE-STYLE env: ``reset(key)``
+    plus ``step(state, action) -> (state, terminated:bool)`` with
+    survival (+1/step until termination) reward — CartPole and direct
+    subclasses. Envs with shaped rewards (Pendulum) or parameterized
+    steps (ParamCartPole.rollout_p) need their own recurrent variant.
+    Same masked-scan shape as the stateless rollouts (static shapes, no
+    early exit), with the policy's hidden state threaded through the
+    carry — fully jittable and vmappable over (flat_params, key)."""
+    import jax
+    import jax.numpy as jnp
+
+    steps = max_steps or env_cls.max_steps
+    state0 = env_cls.reset(key)
+    h0 = policy.init_carry()
+
+    def scan_step(carry, _):
+        state, h, done, total = carry
+        new_h, action = policy.act_step(flat_params, h, state)
+        next_state, terminated = env_cls.step(state, action)
+        reward = jnp.where(done, 0.0, 1.0)
+        new_done = done | terminated
+        new_state = jnp.where(done, state, next_state)
+        keep_h = jnp.where(done, h, new_h)
+        return (new_state, keep_h, new_done, total + reward), None
+
+    (_, _, _, total), _ = jax.lax.scan(
+        scan_step, (state0, h0, jnp.asarray(False), jnp.asarray(0.0)),
+        None, length=steps,
+    )
+    return total

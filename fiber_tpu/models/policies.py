@@ -140,3 +140,86 @@ class ConvPolicy:
         import jax.numpy as jnp
 
         return jnp.argmax(self.apply(flat_params, obs))
+
+
+class GRUPolicy:
+    """Single-layer GRU with a linear readout, as flat parameter vectors —
+    the recurrent model family for partially-observable ES tasks (the
+    reference's ES examples are feed-forward only; memory policies are
+    the standard extension for masked/occluded observations).
+
+    Contract: ``init_carry()`` gives the zero hidden state;
+    ``act_step(flat_params, carry, obs) -> (carry', action)`` advances
+    one step. Use ``fiber_tpu.models.rollout_recurrent`` to evaluate on
+    any env with the reset/step interface; everything stays jittable and
+    vmappable (a population of GRUs is one (pop, dim) tensor, same as
+    the MLP path)."""
+
+    def __init__(self, obs_dim: int, act_dim: int,
+                 hidden: int = 32) -> None:
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.hidden = hidden
+        # 3 gates x (W: obs->h, U: h->h, b) + readout (h->act, b)
+        self.dim = (
+            3 * (obs_dim * hidden + hidden * hidden + hidden)
+            + hidden * act_dim + act_dim
+        )
+
+    def init(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        o, h, a = self.obs_dim, self.hidden, self.act_dim
+        parts = []
+        for fan_in, shape in (
+            (o, (o, h)), (h, (h, h)), (None, (h,)),   # z gate
+            (o, (o, h)), (h, (h, h)), (None, (h,)),   # r gate
+            (o, (o, h)), (h, (h, h)), (None, (h,)),   # candidate
+            (h, (h, a)), (None, (a,)),                # readout
+        ):
+            if fan_in is None:
+                parts.append(jnp.zeros(shape))
+            else:
+                key, wk = jax.random.split(key)
+                parts.append(
+                    (jax.random.normal(wk, shape) / jnp.sqrt(fan_in)).ravel()
+                )
+        return jnp.concatenate(parts)
+
+    def init_carry(self):
+        import jax.numpy as jnp
+
+        return jnp.zeros((self.hidden,))
+
+    def _unpack(self, flat):
+        o, h, a = self.obs_dim, self.hidden, self.act_dim
+        shapes = [(o, h), (h, h), (h,)] * 3 + [(h, a), (a,)]
+        out, offset = [], 0
+        for shape in shapes:
+            n = 1
+            for s in shape:
+                n *= s
+            out.append(flat[offset:offset + n].reshape(shape))
+            offset += n
+        return out
+
+    def step(self, flat_params, carry, obs):
+        """(carry', logits) for one step; jittable/vmappable."""
+        import jax
+
+        (wz, uz, bz, wr, ur, br, wh, uh, bh, wo, bo) = \
+            self._unpack(flat_params)
+        z = jax.nn.sigmoid(obs @ wz + carry @ uz + bz)
+        r = jax.nn.sigmoid(obs @ wr + carry @ ur + br)
+        import jax.numpy as jnp
+
+        cand = jnp.tanh(obs @ wh + (r * carry) @ uh + bh)
+        new_carry = (1.0 - z) * carry + z * cand
+        return new_carry, new_carry @ wo + bo
+
+    def act_step(self, flat_params, carry, obs):
+        import jax.numpy as jnp
+
+        new_carry, logits = self.step(flat_params, carry, obs)
+        return new_carry, jnp.argmax(logits)

@@ -457,12 +457,14 @@ def pool_worker(
             time.sleep(0.1)
             if pending_reports and ctl_addr \
                     and time.monotonic() - last_report_attempt >= 1.0:
-                # ONE attempt per tick: with the master unreachable each
-                # attempt costs a full connect timeout, and burning it
-                # once per tick keeps the monitor reaping/respawning
+                # Drain until the first failure: successful sends are
+                # cheap, so a healthy master absorbs a death burst
+                # immediately; with the master unreachable the first
+                # attempt fails after its connect timeout and the 1s
+                # tick gate keeps the monitor reaping/respawning
                 # instead of starving in doomed connect() calls.
                 last_report_attempt = time.monotonic()
-                if try_report(*pending_reports[0]):
+                while pending_reports and try_report(*pending_reports[0]):
                     pending_reports.pop(0)
             for ident, (c, born) in list(children.items()):
                 code = c.exitcode
@@ -477,14 +479,21 @@ def pool_worker(
                     # Clean recycle ("subgone"): master drops the old
                     # ident's bookkeeping. Crash ("subdead"): master
                     # resubmits the ident's pending chunks NOW rather
-                    # than when the whole job dies. Bounded queue: if
-                    # the master has been unreachable long enough to
-                    # accumulate this many reports, the pool is dead
-                    # anyway — dropping the oldest beats leaking.
+                    # than when the whole job dies. Under a long master
+                    # outage only disposable "subgone" entries (pure
+                    # bookkeeping cleanup) are shed; "subdead" reports
+                    # are NEVER dropped — a lost one would strand its
+                    # ident's pending chunks forever, since the
+                    # respawned slot keeps the job (and its death
+                    # backstop) alive. Each entry is ~50 bytes, so the
+                    # worst case is bounded by the crash count.
                     kind = ("subgone" if code == _SUBWORKER_RECYCLE
                             else "subdead")
                     pending_reports.append((kind, ident))
-                    del pending_reports[:-256]
+                    if len(pending_reports) > 512:
+                        keep = [r for r in pending_reports
+                                if r[0] == "subdead"]
+                        pending_reports = keep
                     last_report_attempt = 0.0
                 if draining:
                     continue
@@ -497,7 +506,7 @@ def pool_worker(
                         fail_streak += 1
                     else:
                         fail_streak = 0
-                    time.sleep(min(0.1 * (2 ** fail_streak), 5.0))
+                    time.sleep(min(0.1 * (2 ** fail_streak), 2.0))
                 new_ident, new_c = spawn(len(children))
                 children[new_ident] = (new_c, time.monotonic())
         # Final flush so a crash right at drain time still gets

@@ -288,3 +288,14 @@ def die_once_sub(x):
     """die_once_marker with its own marker file — used by the
     cpu_per_job packing tests so the two tests can't interfere."""
     return _die_once(x, 5, "fiber_die_once_sub")
+
+
+def die_randomly(x):
+    """~7% chance of hard-killing the worker per execution — churn
+    stress for sub-worker-granular resubmission (every chunk must still
+    complete eventually; tasks are idempotent)."""
+    import os
+
+    if os.urandom(1)[0] < 18:  # 18/256 ≈ 7%
+        os._exit(43)
+    return x * 3

@@ -140,6 +140,21 @@ def test_subworker_death_resubmits_without_job_death():
             os.remove(marker)
 
 
+def test_packed_pool_survives_crash_churn():
+    """Stress: ~7%-per-task hard kills under cpu_per_job packing. Every
+    chunk must complete through repeated subdead resubmission + in-place
+    respawns (reference stress model: tests/test_pool.py pending-table
+    races; this adds the sub-worker dimension the reference lacks)."""
+    fiber_tpu.init(cpu_per_job=2)
+    try:
+        with fiber_tpu.Pool(2) as pool:
+            results = pool.map(targets.die_randomly, range(120),
+                               chunksize=1)
+            assert results == [i * 3 for i in range(120)]
+    finally:
+        fiber_tpu.init(cpu_per_job=1)
+
+
 def test_maxtasksperchild_with_packing():
     """maxtasksperchild recycling must work inside a packed job too: the
     parent respawns a sub-worker that exits on its task budget (exit code

@@ -13,6 +13,40 @@ from __future__ import annotations
 from typing import Callable
 
 
+def _survival_scan(step_fn, act_step_fn, state0, carry0, steps):
+    """THE masked episode loop for survival-reward envs: +1 per step
+    until termination, with static shapes (no early exit — finished
+    episodes freeze their state and stop scoring). One implementation
+    shared by every rollout variant so the masking/termination
+    convention can't drift between them.
+
+    ``act_step_fn(policy_carry, state) -> (policy_carry', action)``
+    (stateless policies pass ``carry0=()``);
+    ``step_fn(state, action) -> (state', terminated: bool)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def scan_step(carry, _):
+        state, pc, done, total = carry
+        new_pc, action = act_step_fn(pc, state)
+        next_state, terminated = step_fn(state, action)
+        reward = jnp.where(done, 0.0, 1.0)
+        new_done = done | terminated
+        new_state = jnp.where(done, state, next_state)
+        keep_pc = jax.tree.map(
+            lambda old, new: jnp.where(done, old, new), pc, new_pc
+        )
+        return (new_state, keep_pc, new_done, total + reward), None
+
+    (_, _, _, total), _ = jax.lax.scan(
+        scan_step,
+        (state0, carry0, jnp.asarray(False), jnp.asarray(0.0)),
+        None, length=steps,
+    )
+    return total
+
+
 class CartPole:
     obs_dim = 4
     act_dim = 2
@@ -71,26 +105,12 @@ class CartPole:
         ``act_fn(flat_params, obs) -> action``. Termination is handled by
         masking inside the scan (static shapes, no early exit).
         """
-        import jax
-        import jax.numpy as jnp
-
         steps = max_steps or cls.max_steps
-        state0 = cls.reset(key)
-
-        def scan_step(carry, _):
-            state, done, total = carry
-            action = act_fn(flat_params, state)
-            next_state, terminated = cls.step(state, action)
-            reward = jnp.where(done, 0.0, 1.0)
-            new_done = done | terminated
-            new_state = jnp.where(done, state, next_state)
-            return (new_state, new_done, total + reward), None
-
-        (final_state, done, total), _ = jax.lax.scan(
-            scan_step, (state0, jnp.asarray(False), jnp.asarray(0.0)),
-            None, length=steps,
+        return _survival_scan(
+            cls.step,
+            lambda carry, state: (carry, act_fn(flat_params, state)),
+            cls.reset(key), (), steps,
         )
-        return total
 
 
 class ParamCartPole(CartPole):
@@ -145,26 +165,12 @@ class ParamCartPole(CartPole):
                   max_steps: int | None = None):
         """Episode reward under a specific physics vector; jittable and
         vmappable over (env_params, flat_params) pairs."""
-        import jax
-        import jax.numpy as jnp
-
         steps = max_steps or cls.max_steps
-        state0 = cls.reset(key)
-
-        def scan_step(carry, _):
-            state, done, total = carry
-            action = act_fn(flat_params, state)
-            next_state, terminated = cls.step_p(env_params, state, action)
-            reward = jnp.where(done, 0.0, 1.0)
-            new_done = done | terminated
-            new_state = jnp.where(done, state, next_state)
-            return (new_state, new_done, total + reward), None
-
-        (_, _, total), _ = jax.lax.scan(
-            scan_step, (state0, jnp.asarray(False), jnp.asarray(0.0)),
-            None, length=steps,
+        return _survival_scan(
+            lambda state, action: cls.step_p(env_params, state, action),
+            lambda carry, state: (carry, act_fn(flat_params, state)),
+            cls.reset(key), (), steps,
         )
-        return total
 
     @classmethod
     def mutate(cls, env_params, key, scale: float = 0.15):
@@ -412,28 +418,12 @@ def rollout_recurrent(env_cls, policy, flat_params, key,
     survival (+1/step until termination) reward — CartPole and direct
     subclasses. Envs with shaped rewards (Pendulum) or parameterized
     steps (ParamCartPole.rollout_p) need their own recurrent variant.
-    Same masked-scan shape as the stateless rollouts (static shapes, no
-    early exit), with the policy's hidden state threaded through the
-    carry — fully jittable and vmappable over (flat_params, key)."""
-    import jax
-    import jax.numpy as jnp
-
+    Same masked-scan loop as the stateless rollouts (shared
+    ``_survival_scan``), with the policy's hidden state threaded through
+    the carry — fully jittable and vmappable over (flat_params, key)."""
     steps = max_steps or env_cls.max_steps
-    state0 = env_cls.reset(key)
-    h0 = policy.init_carry()
-
-    def scan_step(carry, _):
-        state, h, done, total = carry
-        new_h, action = policy.act_step(flat_params, h, state)
-        next_state, terminated = env_cls.step(state, action)
-        reward = jnp.where(done, 0.0, 1.0)
-        new_done = done | terminated
-        new_state = jnp.where(done, state, next_state)
-        keep_h = jnp.where(done, h, new_h)
-        return (new_state, keep_h, new_done, total + reward), None
-
-    (_, _, _, total), _ = jax.lax.scan(
-        scan_step, (state0, h0, jnp.asarray(False), jnp.asarray(0.0)),
-        None, length=steps,
+    return _survival_scan(
+        env_cls.step,
+        lambda h, state: policy.act_step(flat_params, h, state),
+        env_cls.reset(key), policy.init_carry(), steps,
     )
-    return total

@@ -142,6 +142,12 @@ def main() -> int:
     args = parser.parse_args()
     if args.gens < 1:
         parser.error("--gens must be >= 1")
+    if args.poet and args.pixels:
+        parser.error("--poet and --pixels are mutually exclusive")
+    if args.pop is not None and args.pop < 2:
+        parser.error("--pop must be >= 2")
+    if args.steps is not None and args.steps < 1:
+        parser.error("--steps must be >= 1")
 
     metric = ("poet_policy_evals_per_sec" if args.poet
               else "es_pixel_evals_per_sec" if args.pixels
@@ -170,8 +176,10 @@ def main() -> int:
     watchdog.cancel()
 
     if not args.pixels:
-        args.pop = args.pop or 4096
-        args.steps = args.steps or 500
+        if args.pop is None:
+            args.pop = 4096
+        if args.steps is None:
+            args.steps = 500
     if args.poet:
         return _poet_bench(args, devices)
 
@@ -192,8 +200,10 @@ def main() -> int:
         # pop is smaller; an explicit --pop/--steps always wins (the
         # parser defaults are None sentinels).
         policy = ConvPolicy(PixelChase.obs_shape, PixelChase.act_dim)
-        args.pop = args.pop or 1024
-        args.steps = args.steps or PixelChase.max_steps
+        if args.pop is None:
+            args.pop = 1024
+        if args.steps is None:
+            args.steps = PixelChase.max_steps
 
         def eval_fn(theta, key):
             return PixelChase.rollout(policy.act, theta, key,

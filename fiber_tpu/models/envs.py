@@ -33,11 +33,15 @@ def _survival_scan(step_fn, act_step_fn, state0, carry0, steps):
         next_state, terminated = step_fn(state, action)
         reward = jnp.where(done, 0.0, 1.0)
         new_done = done | terminated
-        new_state = jnp.where(done, state, next_state)
+        # tree.map on BOTH freezes so pytree env states work the same
+        # as pytree policy carries.
+        keep_state = jax.tree.map(
+            lambda old, new: jnp.where(done, old, new), state, next_state
+        )
         keep_pc = jax.tree.map(
             lambda old, new: jnp.where(done, old, new), pc, new_pc
         )
-        return (new_state, keep_pc, new_done, total + reward), None
+        return (keep_state, keep_pc, new_done, total + reward), None
 
     (_, _, _, total), _ = jax.lax.scan(
         scan_step,

@@ -366,8 +366,11 @@ def _poet_bench(args, devices) -> int:
     t0 = time.perf_counter()
     history = poet.run(jax.random.PRNGKey(0), iters, es_steps=es_steps)
     elapsed = time.perf_counter() - t0
-    total_evals = sum(h["pairs"] * poet.pop_size * es_steps
-                      for h in history)
+    total_evals = sum(
+        h["pairs"] * poet.pop_size * es_steps
+        + h.get("transfer_evals", 0)
+        for h in history
+    )
     per_chip_share = NORTH_STAR_EVALS_PER_SEC / NORTH_STAR_CHIPS
     result = {
         "metric": "poet_policy_evals_per_sec",

@@ -21,13 +21,44 @@ def echo_worker(q_in, q_out, n):
         q_out.put(q_in.get())
 
 
+def drain_worker(q_in, q_done, n):
+    """One-way consumer: drain n messages, then report completion."""
+    for _ in range(n):
+        q_in.get()
+    q_done.put("done")
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--msgs", type=int, default=20_000)
     parser.add_argument("--size", type=int, default=1024)
+    parser.add_argument("--stream", action="store_true",
+                        help="one-way streaming throughput instead of "
+                             "round-trips (round-trips measure latency; "
+                             "this measures the pipe's actual rate)")
     args = parser.parse_args()
 
     import fiber_tpu
+
+    if args.stream:
+        q_in, q_done = fiber_tpu.SimpleQueue(), fiber_tpu.SimpleQueue()
+        p = fiber_tpu.Process(target=drain_worker,
+                              args=(q_in, q_done, args.msgs))
+        p.start()
+        payload = b"x" * args.size
+        t0 = time.time()
+        for _ in range(args.msgs):
+            q_in.put(payload)
+        assert q_done.get(60) == "done"
+        elapsed = time.time() - t0
+        p.join(30)
+        rate = args.msgs / elapsed
+        mbps = rate * args.size * 8 / 1e6
+        print(f"{args.msgs} one-way msgs of {args.size}B in "
+              f"{elapsed:.2f}s: {rate:,.0f} msgs/s, {mbps:,.1f} Mbps")
+        q_in.close()
+        q_done.close()
+        return 0
 
     q_in, q_out = fiber_tpu.SimpleQueue(), fiber_tpu.SimpleQueue()
     p = fiber_tpu.Process(target=echo_worker,

@@ -135,6 +135,7 @@ def main() -> int:
             # 2./3. Transfer + env mutation stay on the master (tiny).
             key, k_t, k_s = jax.random.split(key, 3)
             transfers = poet.transfer(k_t)
+            total_evals += poet.last_transfer_evals
             spawned = poet.try_spawn_envs(k_s)
             print(f"iter {it}: pairs={len(poet.envs)} fitness={fits} "
                   f"transfers={transfers} spawned={spawned}", flush=True)

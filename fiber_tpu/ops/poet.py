@@ -72,6 +72,7 @@ class POET:
         self.archive: List = [np.asarray(env_cls.DEFAULT, dtype=float)]
         self.novelty_k = 3
         self._es = None  # one shared compiled ES step (lazy)
+        self.last_transfer_evals = 0
 
         def eval_pair(env_params, theta, key):
             return env_cls.rollout_p(
@@ -120,48 +121,94 @@ class POET:
         perturbations ignored (masked out by zero lr contribution —
         cheaper than a second compiled ES variant)."""
         import jax
+
+        theta, stats = self._finetune(
+            self.agents[idx], self.envs[idx], key, es_steps
+        )
+        self.agents[idx] = theta
+        return float(jax.device_get(stats)[0])
+
+    def _finetune(self, theta, env_params, key, steps: int):
+        """THE 'ES with the env tail pinned back' loop, shared by
+        optimize_pair and the proposal-transfer stage: optimizes a COPY
+        of ``theta`` on ``env_params`` (the caller decides whether it
+        replaces a population slot). Returns (new_theta, last_stats)."""
+        import jax
         import jax.numpy as jnp
 
         es = self._get_es()
-        combined = jnp.concatenate([self.agents[idx], self.envs[idx]])
-        best_stats = None
-        for _ in range(es_steps):
+        combined = jnp.concatenate([theta, env_params])
+        stats = None
+        for _ in range(steps):
             key, sub = jax.random.split(key)
             combined, stats = es.step(combined, sub)
-            # env tail must not drift: ES perturbs it, but the pair's env
-            # is fixed — pin it back each step.
-            combined = combined.at[self.policy.dim:].set(self.envs[idx])
-            best_stats = stats
-        self.agents[idx] = combined[: self.policy.dim]
-        return float(jax.device_get(best_stats)[0])
+            # env tail must not drift: ES perturbs it, but the pair's
+            # env is fixed — pin it back each step.
+            combined = combined.at[self.policy.dim:].set(env_params)
+        return combined[: self.policy.dim], stats
 
-    def transfer(self, key) -> int:
-        """Evaluate every agent on every env; adopt better agents
-        (the POET transfer step). Returns number of transfers."""
+    def transfer(self, key, proposal_steps: int = 1) -> int:
+        """Evaluate every agent on every env; adopt better agents — the
+        published POET's two-stage transfer. Stage 1 (direct): the full
+        (n_env, n_agent) cross matrix in one vmapped program. Stage 2
+        (proposal): the best foreign candidate per env is fine-tuned
+        with ``proposal_steps`` ES steps on that env before the final
+        comparison against the incumbent — a policy one optimization
+        step away from beating the incumbent still transfers (the paper
+        found direct-only transfer misses most useful migrations).
+        ``proposal_steps=0`` reverts to direct-only. Returns the number
+        of adoptions."""
         import jax
         import numpy as np
 
         n_env, n_agent = len(self.envs), len(self.agents)
         if n_env == 0 or n_agent < 2:
+            self.last_transfer_evals = 0
             return 0
         import jax.numpy as jnp
 
+        # Snapshot: candidates AND the cross matrix must describe the
+        # same population — adoptions inside the loop below must not
+        # let env e+1 judge a just-overwritten agent by the old
+        # agent's fitness row.
+        agents_before = list(self.agents)
         envs = jnp.stack(self.envs)
-        agents = jnp.stack(self.agents)
-        keys = jax.random.split(key, n_agent)
+        agents = jnp.stack(agents_before)
+        key, mkey = jax.random.split(key)
+        keys = jax.random.split(mkey, n_agent)
         matrix = np.asarray(jax.device_get(
             self._cross(envs, agents, keys)
         ))  # (n_env, n_agent)
         transfers = 0
+        proposal_evals = 0
+        es_pop = self._get_es().pop_size
         for e in range(n_env):
             best_agent = int(matrix[e].argmax())
             incumbent = matrix[e, e]
             # Additive margin scaled by |incumbent| so the acceptance test
             # is meaningful for zero/negative fitness too.
             margin = 0.05 * max(1.0, abs(float(incumbent)))
-            if best_agent != e and matrix[e, best_agent] > incumbent + margin:
-                self.agents[e] = self.agents[best_agent]
+            if best_agent == e:
+                continue
+            candidate = agents_before[best_agent]
+            cand_fit = matrix[e, best_agent]
+            if proposal_steps > 0:
+                key, fkey, ekey = jax.random.split(key, 3)
+                tuned, _ = self._finetune(candidate, self.envs[e], fkey,
+                                          proposal_steps)
+                tuned_fit = float(jax.device_get(
+                    self._eval_pair(self.envs[e], tuned, ekey)
+                ))
+                proposal_evals += proposal_steps * es_pop + 1
+                if tuned_fit > cand_fit:
+                    candidate, cand_fit = tuned, tuned_fit
+            if cand_fit > incumbent + margin:
+                self.agents[e] = candidate
                 transfers += 1
+        #: evals spent inside the proposal stage of the LAST transfer()
+        #: call — benchmarks add this to their totals so proposal work
+        #: isn't silently uncounted.
+        self.last_transfer_evals = proposal_evals
         return transfers
 
     def novelty(self, env_params) -> float:
@@ -243,6 +290,7 @@ class POET:
                 "mean_fitness": sum(means) / len(means),
                 "spawned": spawned,
                 "transfers": transfers,
+                "transfer_evals": self.last_transfer_evals,
                 "archive_size": len(self.archive),
             }
             history.append(record)

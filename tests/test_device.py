@@ -633,3 +633,36 @@ def test_pgpe_trains_cartpole():
     state, history = pgpe.run(state, jax.random.PRNGKey(1), 3)
     final = np.asarray(jax.device_get(history[-1]))
     assert final.shape == (3,) and np.isfinite(final).all()
+
+
+def test_poet_proposal_transfer():
+    """Published-POET two-stage transfer: the proposal stage fine-tunes
+    the best foreign candidate before the final comparison; direct-only
+    remains available via proposal_steps=0."""
+    import jax
+
+    from fiber_tpu.models.envs import ParamCartPole
+    from fiber_tpu.ops.poet import POET
+
+    policy = MLPPolicy(ParamCartPole.obs_dim, ParamCartPole.act_dim,
+                       hidden=(8,))
+    poet = POET(ParamCartPole, policy, pop_size=32, max_pairs=3,
+                rollout_steps=60, mc_low=5.0)
+    key = jax.random.PRNGKey(0)
+    # grow to >=2 pairs so transfer has candidates
+    key, k1, k2 = jax.random.split(key, 3)
+    poet.optimize_pair(0, k1, es_steps=2)
+    poet.try_spawn_envs(k2)
+    assert len(poet.envs) >= 2
+
+    tuned, stats = poet._finetune(poet.agents[0], poet.envs[0],
+                                  jax.random.PRNGKey(3), 1)
+    assert tuned.shape == (policy.dim,)
+    assert stats is not None
+    assert float(jax.numpy.abs(tuned - poet.agents[0]).max()) > 0.0
+
+    for steps in (0, 1):
+        n = poet.transfer(jax.random.PRNGKey(4), proposal_steps=steps)
+        assert isinstance(n, int) and n >= 0
+        for agent in poet.agents:
+            assert agent.shape == (policy.dim,)

@@ -85,3 +85,15 @@ def test_job_spec_prefers_explicit_meta_hints():
     launcher.backend = get_backend()
     spec = launcher._job_spec(p, ["true"])
     assert spec.cpu == 7
+
+
+def test_jax_distributed_fused_es_step():
+    """Beyond the bare psum: the REAL pod training path — a fused
+    EvolutionStrategy run over the global mesh spanning 2 processes,
+    with cross-process replication of the updated params verified
+    through the mesh's own collectives."""
+    from fiber_tpu.parallel.ring import jax_distributed_initializer
+
+    ring = Ring(2, targets.jax_distributed_es_step,
+                initializer=jax_distributed_initializer)
+    ring.run()

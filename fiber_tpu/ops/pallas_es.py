@@ -43,6 +43,38 @@ def _bits_to_uniform(bits):
     return pltpu.bitcast(one_to_two, jnp.float32) - 1.0
 
 
+def _fmix32(x):
+    """murmur3 finalizer — a bijection on 32-bit ints (int32 arithmetic:
+    multiplies wrap two's-complement, shifts are explicitly logical)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    x = x ^ lax.shift_right_logical(x, 16)
+    x = x * jnp.int32(-2048144789)      # 0x85EBCA6B
+    x = x ^ lax.shift_right_logical(x, 13)
+    x = x * jnp.int32(-1028477611)      # 0xC2B2AE35
+    x = x ^ lax.shift_right_logical(x, 16)
+    return x
+
+
+def _seed_tile_prng(seed_ref, pair_block, j, dim_blocks):
+    """Seed the per-core PRNG for one (pair_block, dim_block) tile.
+
+    Mosaic hardware accepts at most TWO seed words, so the tile
+    coordinates are folded into the caller's two words with a murmur3
+    finalizer: the tile index is globally unique and _fmix32 is a
+    bijection, so distinct tiles always land on distinct word pairs
+    while both passes (perturb / gradient) regenerate identical noise.
+    """
+    import jax.numpy as jnp
+    from jax.experimental.pallas import tpu as pltpu
+
+    tile = pair_block * jnp.int32(dim_blocks) + j
+    s0 = seed_ref[0] ^ _fmix32(tile)
+    s1 = seed_ref[1] ^ _fmix32(tile ^ jnp.int32(-1640531527))  # 0x9E3779B9
+    pltpu.prng_seed(s0, s1)
+
+
 def _gaussian_tile(shape):
     """Standard-normal tile from the seeded per-core PRNG (Box-Muller)."""
     import jax.numpy as jnp
@@ -60,10 +92,9 @@ def _gaussian_tile(shape):
 
 
 def _perturb_kernel(seed_ref, sigma_ref, params_ref, out_ref, *,
-                    pair_blocks):
+                    pair_blocks, dim_blocks):
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     i = pl.program_id(0)   # output row-block over 2*pairs
     j = pl.program_id(1)   # dim block
@@ -73,23 +104,22 @@ def _perturb_kernel(seed_ref, sigma_ref, params_ref, out_ref, *,
     # large meshes).
     pair_block = jnp.where(i < pair_blocks, i, i - pair_blocks)
     sign = jnp.where(i < pair_blocks, 1.0, -1.0)
-    pltpu.prng_seed(seed_ref[0], seed_ref[1], pair_block, j)
+    _seed_tile_prng(seed_ref, pair_block, j, dim_blocks)
     eps = _gaussian_tile(out_ref.shape)
     out_ref[:] = params_ref[:] + sign * sigma_ref[0] * eps
 
 
-def _wsum_kernel(seed_ref, w_ref, out_ref):
+def _wsum_kernel(seed_ref, w_ref, out_ref, *, dim_blocks):
     """Accumulate w_tile @ eps_tile into the dim-block output, regenerating
     eps with the same seeding as the perturb pass. The pair (reduction)
     axis is the minor-most grid axis so each output block's revisits are
     contiguous (TPU accumulation-grid requirement)."""
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     j = pl.program_id(0)   # dim block (major)
     i = pl.program_id(1)   # pair block (minor: accumulation)
-    pltpu.prng_seed(seed_ref[0], seed_ref[1], i, j)
+    _seed_tile_prng(seed_ref, i, j, dim_blocks)
     eps = _gaussian_tile((w_ref.shape[-1], out_ref.shape[-1]))
 
     @pl.when(i == 0)
@@ -132,7 +162,8 @@ def build_perturb(pairs: int, dim: int, sigma: Optional[float] = None,
         dim_blocks = pad_dim // DIM_BLOCK
 
         call = pl.pallas_call(
-            functools.partial(_perturb_kernel, pair_blocks=pair_blocks),
+            functools.partial(_perturb_kernel, pair_blocks=pair_blocks,
+                              dim_blocks=dim_blocks),
             grid=(2 * pair_blocks, dim_blocks),
             in_specs=[
                 pl.BlockSpec((2,), lambda i, j: (0,)),           # seed words
@@ -182,7 +213,7 @@ def build_weighted_eps_sum(pairs: int, dim: int,
     pad_dim = _pad_to(max(dim, DIM_BLOCK), DIM_BLOCK)
 
     call = pl.pallas_call(
-        _wsum_kernel,
+        functools.partial(_wsum_kernel, dim_blocks=pad_dim // DIM_BLOCK),
         grid=(pad_dim // DIM_BLOCK, pad_pairs // PAIR_BLOCK),
         in_specs=[
             pl.BlockSpec((2,), lambda j, i: (0,)),

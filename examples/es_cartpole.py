@@ -25,6 +25,10 @@ def main():
     parser.add_argument("--hidden", type=int, default=32)
     parser.add_argument("--optimizer", default="sgd",
                         choices=("sgd", "adam"))
+    parser.add_argument("--algo", default="es",
+                        choices=("es", "pgpe", "cma"),
+                        help="algorithm family: OpenAI-ES (default), "
+                             "PGPE, or sep-CMA-ES")
     parser.add_argument("--fused", action="store_true",
                         help="run generations as fused lax.scan chunks")
     args = parser.parse_args()
@@ -40,6 +44,29 @@ def main():
     def eval_fn(theta, key):
         return CartPole.rollout(policy.act, theta, key,
                                 max_steps=args.steps)
+
+    if args.algo != "es":
+        if args.fused or args.optimizer != "sgd":
+            parser.error("--fused/--optimizer apply only to --algo es")
+        from fiber_tpu.ops import PGPE, SepCMAES
+
+        cls = PGPE if args.algo == "pgpe" else SepCMAES
+        opt = cls(eval_fn, dim=policy.dim, pop_size=args.pop)
+        state = opt.init_state(policy.init(jax.random.PRNGKey(0)))
+        t0 = time.time()
+        state, hist = opt.run(state, jax.random.PRNGKey(1), args.gens)
+        jax.block_until_ready(state[0])
+        elapsed = time.time() - t0
+        every = max(1, args.gens // 10)
+        for g, stats in enumerate(hist):
+            if g % every == 0 or g == args.gens - 1:
+                s = jax.device_get(stats)
+                print(f"gen {g:4d}  mean {float(s[0]):8.2f}  "
+                      f"best {float(s[1]):8.2f}")
+        evals = opt.pop_size * args.gens
+        print(f"{evals} policy evals in {elapsed:.1f}s "
+              f"= {evals / elapsed:,.0f} evals/s [{args.algo}]")
+        return 0
 
     es = EvolutionStrategy(eval_fn, dim=policy.dim, pop_size=args.pop,
                            sigma=0.1, lr=0.03, optimizer=args.optimizer)

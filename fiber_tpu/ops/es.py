@@ -24,6 +24,20 @@ from __future__ import annotations
 from typing import Callable, Optional, Tuple
 
 
+def run_steps(step, state, key, generations: int):
+    """Shared generation driver for the state-based ES family (PGPE,
+    SepCMAES): N `step(state, key)` calls, returning (state, stats
+    history)."""
+    import jax
+
+    history = []
+    for _ in range(generations):
+        key, sub = jax.random.split(key)
+        state, stats = step(state, sub)
+        history.append(stats)
+    return state, history
+
+
 def centered_rank(x):
     """Map fitness to centered ranks in [-0.5, 0.5] (OpenAI-ES shaping)."""
     import jax.numpy as jnp

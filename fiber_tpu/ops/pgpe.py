@@ -135,11 +135,6 @@ class PGPE:
 
     def run(self, state, key, generations: int):
         """N generations on-device; returns (state, stats_history)."""
-        import jax
+        from fiber_tpu.ops.es import run_steps
 
-        history = []
-        for _ in range(generations):
-            key, sub = jax.random.split(key)
-            state, stats = self.step(state, sub)
-            history.append(stats)
-        return state, history
+        return run_steps(self.step, state, key, generations)

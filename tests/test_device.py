@@ -666,3 +666,52 @@ def test_poet_proposal_transfer():
         assert isinstance(n, int) and n >= 0
         for agent in poet.agents:
             assert agent.shape == (policy.dim,)
+
+
+def test_sep_cma_es_converges_quadratic():
+    """sep-CMA-ES on a deterministic quadratic: the mean converges, the
+    step size adapts, and the diagonal covariance stays positive."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from fiber_tpu.ops import SepCMAES
+
+    target = jnp.asarray([0.5, -0.3, 0.8, 0.0, 0.2, -0.7])
+
+    def eval_fn(theta, key):
+        return -jnp.sum((theta - target) ** 2)
+
+    mesh = Mesh(np.asarray(jax.devices()), ("pool",))
+    cma = SepCMAES(eval_fn, dim=6, pop_size=64, sigma_init=0.3,
+                   mesh=mesh)
+    state = cma.init_state()
+    d0 = float(jnp.sum((state[0] - target) ** 2))
+    state, history = cma.run(state, jax.random.PRNGKey(0), 60)
+    m, sigma, C = state[0], state[1], state[2]
+    d1 = float(jnp.sum((m - target) ** 2))
+    assert d1 < d0 * 0.05, (d0, d1)
+    assert bool(jnp.all(C > 0))
+    assert abs(float(sigma) - cma.sigma_init) > 1e-3  # step size adapted
+    final = np.asarray(jax.device_get(history[-1]))
+    assert np.isfinite(final).all()
+
+
+def test_sep_cma_es_trains_cartpole():
+    """SepCMAES slots into the same policy-rollout contract as ES/PGPE."""
+    import jax
+    from jax.sharding import Mesh
+
+    from fiber_tpu.ops import SepCMAES
+
+    policy = MLPPolicy(CartPole.obs_dim, CartPole.act_dim, hidden=(8,))
+
+    def eval_fn(theta, key):
+        return CartPole.rollout(policy.act, theta, key, max_steps=60)
+
+    mesh = Mesh(np.asarray(jax.devices()), ("pool",))
+    cma = SepCMAES(eval_fn, dim=policy.dim, pop_size=64, mesh=mesh)
+    state = cma.init_state(policy.init(jax.random.PRNGKey(0)))
+    state, history = cma.run(state, jax.random.PRNGKey(1), 3)
+    final = np.asarray(jax.device_get(history[-1]))
+    assert np.isfinite(final).all()

@@ -177,7 +177,7 @@ def main() -> int:
 
     if not args.pixels:
         if args.pop is None:
-            args.pop = 4096
+            args.pop = _tuned_pop(devices[0].platform) or 4096
         if args.steps is None:
             args.steps = 500
     if args.poet:
@@ -309,6 +309,23 @@ _TPU_RECORD_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)),
     "RUNS", "bench_tpu_success.json",
 )
+
+_TUNE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "RUNS", "tune_es.json",
+)
+
+
+def _tuned_pop(platform: str):
+    """Best MLP-ES population recorded by examples/tune_es.py for THIS
+    platform (RUNS/tune_es.json), or None. An explicit --pop wins."""
+    try:
+        with open(_TUNE_PATH) as fh:
+            data = json.load(fh)
+        if data.get("platform") == platform:
+            return int(data["best_pop"])
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
+    return None
 
 
 def _load_tpu_records() -> dict:

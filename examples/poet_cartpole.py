@@ -23,20 +23,52 @@ def main():
     parser.add_argument("--pop", type=int, default=256)
     parser.add_argument("--pairs", type=int, default=6)
     parser.add_argument("--steps", type=int, default=200)
+    parser.add_argument("--env", default="cartpole",
+                        choices=("cartpole", "hill", "biped"),
+                        help="co-evolution domain (biped = the published "
+                             "POET walker-on-obstacle-course shape)")
+    parser.add_argument("--mc-low", type=float, default=None,
+                        help="minimal-criterion floor for admitting new "
+                             "envs (units = the domain's fitness: "
+                             "survival steps for cartpole, metres for "
+                             "the walkers; default 10.0, walkers 0.5)")
+    parser.add_argument("--mc-high", type=float, default=None,
+                        help="minimal-criterion ceiling (reject envs the "
+                             "incumbent already solves this well); "
+                             "cartpole defaults to 0.9*steps, walkers "
+                             "to a distance matched to their speed "
+                             "scale")
     args = parser.parse_args()
+    # Walker fitness is metres, not survival steps: both minimal-
+    # criterion bounds need distance-scale defaults or the 'not
+    # trivially easy' half never engages.
+    if args.mc_low is None:
+        args.mc_low = 10.0 if args.env == "cartpole" else 0.5
+    if args.mc_high is None and args.env != "cartpole":
+        # ~90% of a good walker's reachable distance (hill walkers move
+        # ~3x faster than the biped's ~2 m/s at dt=0.05 vs 0.025)
+        per_step = 0.15 if args.env == "hill" else 0.045
+        args.mc_high = per_step * args.steps
 
     import jax
 
     from fiber_tpu.models import MLPPolicy
-    from fiber_tpu.models.envs import ParamCartPole
+    from fiber_tpu.models.envs import (
+        ParamBipedWalker,
+        ParamCartPole,
+        ParamHillWalker,
+    )
     from fiber_tpu.ops.poet import POET
 
-    policy = MLPPolicy(ParamCartPole.obs_dim, ParamCartPole.act_dim,
+    env_cls = {"cartpole": ParamCartPole, "hill": ParamHillWalker,
+               "biped": ParamBipedWalker}[args.env]
+    policy = MLPPolicy(env_cls.obs_dim, env_cls.act_dim,
                        hidden=(16,))
     poet = POET(
-        ParamCartPole, policy,
+        env_cls, policy,
         pop_size=args.pop, max_pairs=args.pairs,
-        rollout_steps=args.steps,
+        rollout_steps=args.steps, mc_low=args.mc_low,
+        mc_high=args.mc_high,
     )
     t0 = time.time()
     history = poet.run(jax.random.PRNGKey(0), args.iters, es_steps=4,

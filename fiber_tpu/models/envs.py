@@ -13,6 +13,31 @@ from __future__ import annotations
 from typing import Callable
 
 
+def _scan_unroll() -> int:
+    """FIBER_ROLLOUT_UNROLL trades compiled-code size for fewer loop
+    iterations in every env rollout scan (read at trace time; TPU scans
+    with tiny bodies often gain from 2-8). Sweepable without API churn:
+    tune_es/bench runs set the env var."""
+    import os
+
+    try:
+        return max(1, int(os.environ.get("FIBER_ROLLOUT_UNROLL", "1")))
+    except ValueError:
+        return 1
+
+
+def _mutate_bounded(env_params, key, low, high, scale):
+    """Shared POET env mutation: clip-bounded gaussian perturbation of
+    the parameter vector (one implementation for every Param* env)."""
+    import jax
+    import jax.numpy as jnp
+
+    low = jnp.asarray(low)
+    high = jnp.asarray(high)
+    noise = jax.random.normal(key, low.shape) * scale * (high - low)
+    return jnp.clip(jnp.asarray(env_params) + noise, low, high)
+
+
 def _survival_scan(step_fn, act_step_fn, state0, carry0, steps):
     """THE masked episode loop for survival-reward envs: +1 per step
     until termination, with static shapes (no early exit — finished
@@ -46,7 +71,7 @@ def _survival_scan(step_fn, act_step_fn, state0, carry0, steps):
     (_, _, _, total), _ = jax.lax.scan(
         scan_step,
         (state0, carry0, jnp.asarray(False), jnp.asarray(0.0)),
-        None, length=steps,
+        None, length=steps, unroll=_scan_unroll(),
     )
     return total
 
@@ -179,13 +204,8 @@ class ParamCartPole(CartPole):
     @classmethod
     def mutate(cls, env_params, key, scale: float = 0.15):
         """Perturb the physics vector within bounds (POET env mutation)."""
-        import jax
-        import jax.numpy as jnp
-
-        low = jnp.asarray(cls.PARAM_LOW)
-        high = jnp.asarray(cls.PARAM_HIGH)
-        noise = jax.random.normal(key, (4,)) * scale * (high - low)
-        return jnp.clip(jnp.asarray(env_params) + noise, low, high)
+        return _mutate_bounded(env_params, key, cls.PARAM_LOW,
+                               cls.PARAM_HIGH, scale)
 
 
 class Pendulum:
@@ -252,7 +272,8 @@ class Pendulum:
             return (new_state, total + reward), None
 
         (_, total), _ = jax.lax.scan(
-            scan_step, (state0, jnp.asarray(0.0)), None, length=steps
+            scan_step, (state0, jnp.asarray(0.0)), None, length=steps,
+            unroll=_scan_unroll()
         )
         return total
 
@@ -317,7 +338,8 @@ class PixelChase:
             return (agent, total + reward), None
 
         (_, total), _ = jax.lax.scan(
-            scan_step, (agent0, jnp.asarray(0.0)), None, length=steps
+            scan_step, (agent0, jnp.asarray(0.0)), None, length=steps,
+            unroll=_scan_unroll()
         )
         return total
 
@@ -396,7 +418,8 @@ class ParamHillWalker:
             return (x, v), None
 
         (x, _v), _ = jax.lax.scan(
-            scan_step, (x0, v0), None, length=steps
+            scan_step, (x0, v0), None, length=steps,
+            unroll=_scan_unroll()
         )
         return x
 
@@ -404,14 +427,178 @@ class ParamHillWalker:
     def mutate(cls, env_params, key, scale: float = 0.15):
         """Perturb the terrain amplitudes within bounds (POET env
         mutation)."""
+        return _mutate_bounded(env_params, key, cls.PARAM_LOW,
+                               cls.PARAM_HIGH, scale)
+
+
+class ParamBipedWalker:
+    """Planar biped on a parameterized obstacle course — the published
+    POET domain shape (modified BipedalWalker-Hardcore: the reference's
+    gecco-2020 workload evolves terrain roughness / stump / gap
+    parameters) rebuilt as compiled XLA.
+
+    Simplified articulated model that keeps the domain's control
+    problem: a hull (x, y, vx, vy, phi, omega) rides two massless
+    telescoping legs (world-frame hip angles theta_i, lengths L_i) with
+    spring-damper ground contact; contact forces torque the hull, so the
+    agent must coordinate both legs to move forward without toppling.
+    Actions are bang-bang: 16 discrete combos of (hip1, hip2, dL1, dL2)
+    rate signs — argmax-policy compatible (same ``policy.act`` contract
+    POET drives, fiber_tpu/ops/poet.py:78).
+
+    Env params = (4 roughness amplitudes, stump height, gap depth): the
+    POET paper's difficulty axes. All zeros = flat ground. Fitness is
+    forward distance; episodes freeze on termination (static shapes).
+    """
+
+    obs_dim = 14
+    act_dim = 16
+    max_steps = 400
+
+    dt = 0.025
+    gravity = 9.8
+    mass = 1.0
+    inertia = 0.5
+    hip_rate = 3.0       # rad/s
+    len_rate = 1.5       # m/s
+    theta_lim = 0.9
+    len_low, len_high = 0.5, 1.2
+    k_contact = 120.0
+    d_contact = 6.0
+    k_friction = 4.0
+    omega_damp = 1.0
+
+    FREQS = (0.4, 0.8, 1.5, 2.7)
+    DEFAULT = (0.0,) * 6
+    PARAM_LOW = (0.0,) * 6
+    PARAM_HIGH = (0.4, 0.4, 0.3, 0.2, 0.5, 0.6)
+
+    @classmethod
+    def height(cls, env_params, x):
+        """Terrain height: roughness + periodic stumps - periodic gaps.
+        Analytic (jittable); obstacles start ~3m from spawn."""
+        import jax.numpy as jnp
+
+        p = jnp.asarray(env_params)
+        freqs = jnp.asarray(cls.FREQS)
+        rough = jnp.sum(p[:4] * jnp.sin(freqs * x))
+        stump = p[4] * jnp.exp(-jnp.sin(0.5 * (x - 3.0)) ** 2 / 0.01)
+        gap = p[5] * jnp.exp(-jnp.sin(0.35 * (x - 5.0)) ** 2 / 0.02)
+        return rough + stump - gap
+
+    @classmethod
+    def _slope(cls, env_params, x):
+        return (cls.height(env_params, x + 0.1)
+                - cls.height(env_params, x - 0.1)) / 0.2
+
+    @classmethod
+    def rollout_p(cls, act_fn, env_params, flat_params, key,
+                  max_steps: int | None = None):
+        """Forward distance on a specific course; jittable/vmappable —
+        same contract as ParamCartPole/ParamHillWalker.rollout_p."""
         import jax
         import jax.numpy as jnp
 
-        low = jnp.asarray(cls.PARAM_LOW)
-        high = jnp.asarray(cls.PARAM_HIGH)
-        noise = jax.random.normal(key, (len(cls.FREQS),)) \
-            * scale * (high - low)
-        return jnp.clip(jnp.asarray(env_params) + noise, low, high)
+        steps = max_steps or cls.max_steps
+        y0 = cls.height(env_params, 0.0) + 1.0
+        jitter = 0.02 * jax.random.normal(key, (2,))
+
+        # state: x, y, vx, vy, phi, omega, th1, th2, L1, L2
+        state0 = jnp.asarray([
+            0.0, y0, 0.0, 0.0, jitter[0], 0.0,
+            0.15 + jitter[1], -0.15, 1.0, 1.0,
+        ])
+
+        def leg_forces(x, y, vx, vy, th, L, dth, dL, env):
+            fx_pos = x + L * jnp.sin(th)
+            fy_pos = y - L * jnp.cos(th)
+            vfx = vx + dL * jnp.sin(th) + L * jnp.cos(th) * dth
+            vfy = vy - dL * jnp.cos(th) + L * jnp.sin(th) * dth
+            pen = cls.height(env, fx_pos) - fy_pos
+            contact = pen > 0.0
+            normal = jnp.where(
+                contact,
+                jnp.maximum(cls.k_contact * pen - cls.d_contact * vfy,
+                            0.0),
+                0.0)
+            friction = jnp.where(
+                contact,
+                jnp.clip(-cls.k_friction * vfx, -0.8 * normal,
+                         0.8 * normal),
+                0.0)
+            rx, ry = fx_pos - x, fy_pos - y
+            torque = rx * normal - ry * friction
+            return friction, normal, torque, contact
+
+        def scan_step(carry, _):
+            state, done, best_x = carry
+            x, y, vx, vy, phi, om, th1, th2, L1, L2 = state
+
+            obs = jnp.stack([
+                vx / 3.0, vy / 3.0, om, jnp.sin(phi), jnp.cos(phi),
+                th1, th2, L1, L2,
+                # previous-step contact proxies: current penetration
+                jnp.asarray(
+                    cls.height(env_params, x + L1 * jnp.sin(th1))
+                    >= y - L1 * jnp.cos(th1), jnp.float32),
+                jnp.asarray(
+                    cls.height(env_params, x + L2 * jnp.sin(th2))
+                    >= y - L2 * jnp.cos(th2), jnp.float32),
+                cls._slope(env_params, x + 0.3),
+                cls._slope(env_params, x + 0.8),
+                y - cls.height(env_params, x),
+            ])
+            action = act_fn(flat_params, obs)
+            bit = lambda k: 2.0 * jnp.asarray(
+                (action >> k) & 1, jnp.float32) - 1.0
+            dth1 = bit(3) * cls.hip_rate
+            dth2 = bit(2) * cls.hip_rate
+            dL1 = bit(1) * cls.len_rate
+            dL2 = bit(0) * cls.len_rate
+
+            f1x, f1y, t1, _c1 = leg_forces(x, y, vx, vy, th1, L1,
+                                           dth1, dL1, env_params)
+            f2x, f2y, t2, _c2 = leg_forces(x, y, vx, vy, th2, L2,
+                                           dth2, dL2, env_params)
+
+            ax = (f1x + f2x) / cls.mass
+            ay = (f1y + f2y) / cls.mass - cls.gravity
+            alpha = (t1 + t2) / cls.inertia - cls.omega_damp * om
+
+            nvx = vx + cls.dt * ax
+            nvy = vy + cls.dt * ay
+            nom = om + cls.dt * alpha
+            nx = x + cls.dt * nvx
+            ny = y + cls.dt * nvy
+            nphi = phi + cls.dt * nom
+            nth1 = jnp.clip(th1 + cls.dt * dth1, -cls.theta_lim,
+                            cls.theta_lim)
+            nth2 = jnp.clip(th2 + cls.dt * dth2, -cls.theta_lim,
+                            cls.theta_lim)
+            nL1 = jnp.clip(L1 + cls.dt * dL1, cls.len_low, cls.len_high)
+            nL2 = jnp.clip(L2 + cls.dt * dL2, cls.len_low, cls.len_high)
+
+            new_state = jnp.stack([
+                nx, ny, nvx, nvy, nphi, nom, nth1, nth2, nL1, nL2,
+            ])
+            fell = ((ny - cls.height(env_params, nx) < 0.3)
+                    | (jnp.abs(nphi) > 1.2))
+            keep = jnp.where(done, state, new_state)
+            new_best = jnp.where(done, best_x, jnp.maximum(best_x, nx))
+            return (keep, done | fell, new_best), None
+
+        (_, _, best_x), _ = jax.lax.scan(
+            scan_step, (state0, jnp.asarray(False), jnp.asarray(0.0)),
+            None, length=steps, unroll=_scan_unroll(),
+        )
+        return best_x
+
+    @classmethod
+    def mutate(cls, env_params, key, scale: float = 0.15):
+        """Perturb the course parameters within bounds (POET env
+        mutation; difficulty grows from flat ground)."""
+        return _mutate_bounded(env_params, key, cls.PARAM_LOW,
+                               cls.PARAM_HIGH, scale)
 
 
 def rollout_recurrent(env_cls, policy, flat_params, key,

@@ -47,7 +47,10 @@ class SepCMAES:
         self.sigma_init = float(sigma_init)
         self.mesh = mesh or default_mesh()
         self.n_dev = int(np.prod(list(self.mesh.shape.values())))
-        self.pop_size = max(self.n_dev,
+        # Floor at 2/device so mu = lam//2 >= 1 (mu=0 would 0/0 the
+        # weight normalization) — same quantum posture as PGPE.
+        quantum = 2 * self.n_dev
+        self.pop_size = max(quantum,
                             (pop_size // self.n_dev) * self.n_dev)
         self.lam_per_dev = self.pop_size // self.n_dev
 

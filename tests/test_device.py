@@ -785,10 +785,16 @@ def test_poet_on_biped_walker():
 
     pol = MLPPolicy(W.obs_dim, W.act_dim, hidden=(8,))
     poet = POET(W, pol, pop_size=32, max_pairs=3, rollout_steps=60,
-                mc_low=0.1)
+                mc_low=0.01)
     key = jax.random.PRNGKey(0)
-    key, k1, k2 = jax.random.split(key, 3)
-    poet.optimize_pair(0, k1, es_steps=2)
-    poet.try_spawn_envs(k2)
-    assert len(poet.envs) >= 1
-    assert len(poet.archive) >= 1
+    n_envs0, n_arch0 = len(poet.envs), len(poet.archive)
+    # env admission is stochastic (minimal criterion on mutated
+    # courses): optimize+spawn until the population actually grows
+    for _ in range(4):
+        key, k1, k2 = jax.random.split(key, 3)
+        poet.optimize_pair(0, k1, es_steps=2)
+        poet.try_spawn_envs(k2)
+        if len(poet.envs) > n_envs0:
+            break
+    assert len(poet.envs) > n_envs0, "no mutated course was admitted"
+    assert len(poet.archive) > n_arch0

@@ -140,6 +140,13 @@ def main() -> int:
                              "benchmark env: modified BipedalWalker — "
                              "mkdocs/introduction.md:441-486) instead "
                              "of MLP CartPole")
+    parser.add_argument("--attention", action="store_true",
+                        help="bench the sequence-parallel plane instead: "
+                             "ring attention tokens/sec at --seq tokens "
+                             "(beyond-parity metric; the reference has "
+                             "no attention at all)")
+    parser.add_argument("--seq", type=int, default=16384,
+                        help="sequence length for --attention")
     parser.add_argument("--ab-pallas", action="store_true",
                         help="also time the ES with use_pallas forced off "
                              "and report both (TPU A/B)")
@@ -148,22 +155,26 @@ def main() -> int:
     args = parser.parse_args()
     if args.gens < 1:
         parser.error("--gens must be >= 1")
-    if sum((args.poet, args.pixels, args.biped)) > 1:
-        parser.error("--poet/--pixels/--biped are mutually exclusive")
+    if sum((args.poet, args.pixels, args.biped, args.attention)) > 1:
+        parser.error("--poet/--pixels/--biped/--attention are mutually "
+                     "exclusive")
     if args.pop is not None and args.pop < 2:
         parser.error("--pop must be >= 2")
     if args.steps is not None and args.steps < 1:
         parser.error("--steps must be >= 1")
+    if args.attention and args.seq < 64:
+        parser.error("--seq must be >= 64")
 
     metric = ("poet_policy_evals_per_sec" if args.poet
               else "es_pixel_evals_per_sec" if args.pixels
               else "es_biped_evals_per_sec" if args.biped
+              else "ring_attention_tokens_per_sec" if args.attention
               else "es_policy_evals_per_sec")
     fail_payload = {
         "metric": metric,
         "value": 0.0,
-        "unit": "evals/s",
-        "vs_baseline": 0.0,
+        "unit": "tokens/s" if args.attention else "evals/s",
+        "vs_baseline": None if args.attention else 0.0,
         "error": "accelerator backend initialization timed out",
     }
 
@@ -190,7 +201,7 @@ def main() -> int:
             args.pop = 4096
         if args.steps is None:
             args.steps = 400 if args.biped else 500
-    elif not args.pixels:
+    elif not (args.pixels or args.attention):
         tuned = _tuned_config(devices[0].platform)
         if args.pop is None:
             args.pop = tuned.get("pop") or 4096
@@ -202,6 +213,8 @@ def main() -> int:
             args.steps = 500
     if args.poet:
         return _poet_bench(args, devices)
+    if args.attention:
+        return _attention_bench(args, devices)
 
     import numpy as np
     from jax.sharding import Mesh
@@ -402,8 +415,19 @@ def _record_or_attach_tpu_run(result: dict, wedged: bool) -> None:
         best_key = metric + "__best"
         prior_best = records.get(best_key) or records.get(metric)
         records[metric] = result
+
+        def work(r):
+            # comparable-effort proxy: a cheaper config (smaller seq /
+            # pop / episode) must not displace a harder-config best
+            if "seq_len" in r:
+                return float(r["seq_len"]) ** 2
+            return (float(r.get("pop_size", 0))
+                    * float(r.get("episode_steps", 1))
+                    * float(r.get("generations", 1)))
+
         if (not isinstance((prior_best or {}).get("value"), (int, float))
-                or result.get("value", 0.0) >= prior_best["value"]):
+                or (result.get("value", 0.0) >= prior_best["value"]
+                    and work(result) >= 0.99 * work(prior_best))):
             records[best_key] = result
         else:
             records[best_key] = prior_best
@@ -419,6 +443,67 @@ def _record_or_attach_tpu_run(result: dict, wedged: bool) -> None:
     recorded = _load_tpu_records().get(result["metric"])
     if recorded and recorded.get("platform") == "tpu":
         result["recorded_tpu_run"] = recorded
+
+
+def _attention_bench(args, devices) -> int:
+    """Sequence-parallel plane: exact ring attention throughput at
+    --seq tokens (sharded over the mesh; blockwise online-softmax on a
+    single device). Beyond-parity metric — the reference has no
+    attention — so vs_baseline is null."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from fiber_tpu.ops import ring_attention
+
+    n_dev = len(devices)
+    mesh = Mesh(np.asarray(devices), ("pool",))
+    seq, heads, head_dim = args.seq, 8, 64
+    seq = max(seq - seq % max(n_dev, 1), n_dev)
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (seq, heads, head_dim)
+    q = jax.random.normal(kq, shape, jnp.bfloat16)
+    k = jax.random.normal(kk, shape, jnp.bfloat16)
+    v = jax.random.normal(kv, shape, jnp.bfloat16)
+
+    watchdog = _watchdog(args.init_timeout, {
+        "metric": "ring_attention_tokens_per_sec", "value": 0.0,
+        "unit": "tokens/s", "vs_baseline": None,
+        "error": "attention compile/warmup timed out",
+    })
+    out = ring_attention(q, k, v, mesh=mesh, causal=True)
+    jax.block_until_ready(out)
+    watchdog.cancel()
+
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = ring_attention(q, k, v, mesh=mesh, causal=True)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+
+    result = {
+        "metric": "ring_attention_tokens_per_sec",
+        "value": round(seq * iters / elapsed, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "seq_len": seq,
+        "heads": heads,
+        "head_dim": head_dim,
+        "causal": True,
+        "dtype": "bfloat16",
+        "n_devices": n_dev,
+        "platform": devices[0].platform,
+        "attn_flops_per_sec": round(
+            # causal exact attention: ~2 * 2 * seq^2/2 * heads * hd
+            2.0 * seq * seq * heads * head_dim * iters / elapsed, 1),
+    }
+    _record_or_attach_tpu_run(result, wedged=args.wedged_fallback)
+    _emit(result)
+    return 0
 
 
 def _poet_bench(args, devices) -> int:

@@ -99,6 +99,9 @@ def harvest() -> None:
         ("biped bench",
          [sys.executable, "bench.py", "--biped", "--no-pool-bench"],
          1500, None),
+        ("attention bench",
+         [sys.executable, "bench.py", "--attention", "--seq", "32768"],
+         1500, None),
     ]
     for name, cmd, timeout, env in steps:
         if cmd is None:

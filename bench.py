@@ -209,6 +209,10 @@ def main() -> int:
             # applies even with an explicit --pop so recorded runs
             # reproduce; surfaced in the JSON line as rollout_unroll
             os.environ["FIBER_ROLLOUT_UNROLL"] = str(tuned["unroll"])
+        if tuned:
+            # '' = unset: an inherited shell value must not override the
+            # recorded operating point's dtype
+            os.environ["FIBER_POLICY_DTYPE"] = tuned.get("dtype", "")
         if args.steps is None:
             args.steps = 500
     if args.poet:
@@ -318,6 +322,8 @@ def main() -> int:
         "use_pallas": bool(es.use_pallas),
         "rollout_unroll": int(os.environ.get("FIBER_ROLLOUT_UNROLL",
                                              "1")),
+        "policy_dtype": (os.environ.get("FIBER_POLICY_DTYPE")
+                         or "float32"),
     }
 
     # The sections below are additive: a failure in any of them must not
@@ -379,6 +385,8 @@ def _tuned_config(platform: str) -> dict:
             out = {"pop": int(data["best_pop"])}
             if data.get("unroll"):
                 out["unroll"] = int(data["unroll"])
+            if data.get("dtype"):
+                out["dtype"] = str(data["dtype"])
             return out
     except (OSError, ValueError, KeyError, TypeError):
         pass

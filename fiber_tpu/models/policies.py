@@ -13,13 +13,34 @@ from __future__ import annotations
 from typing import Callable, Sequence, Tuple
 
 
+def _compute_dtype(explicit):
+    """Policy matmul precision: explicit kwarg, else the
+    FIBER_POLICY_DTYPE env var (trace-time, so hardware sweeps need no
+    API churn), else float32. bfloat16 halves policy HBM/MXU cost on
+    TPU; params/logits stay float32 at the boundary."""
+    import os
+
+    import jax.numpy as jnp
+
+    name = explicit or os.environ.get("FIBER_POLICY_DTYPE", "")
+    if not name:
+        return None
+    return jnp.dtype(name)
+
+
 class MLPPolicy:
-    """Tanh MLP: obs -> hidden* -> logits, as flat parameter vectors."""
+    """Tanh MLP: obs -> hidden* -> logits, as flat parameter vectors.
+
+    ``compute_dtype`` (or env ``FIBER_POLICY_DTYPE``) runs the matmuls
+    in reduced precision (e.g. "bfloat16") while params and outputs
+    stay float32."""
 
     def __init__(self, obs_dim: int, act_dim: int,
-                 hidden: Sequence[int] = (32, 32)) -> None:
+                 hidden: Sequence[int] = (32, 32),
+                 compute_dtype: str | None = None) -> None:
         self.obs_dim = obs_dim
         self.act_dim = act_dim
+        self.compute_dtype = compute_dtype
         self.sizes = (obs_dim, *hidden, act_dim)
         self.dim = sum(
             self.sizes[i] * self.sizes[i + 1] + self.sizes[i + 1]
@@ -47,7 +68,11 @@ class MLPPolicy:
         """Logits for one observation; jittable / vmappable."""
         import jax.numpy as jnp
 
+        dt = _compute_dtype(self.compute_dtype)
         x = obs
+        if dt is not None:
+            x = x.astype(dt)
+            flat_params = flat_params.astype(dt)
         offset = 0
         n_layers = len(self.sizes) - 1
         for i in range(n_layers):
@@ -59,7 +84,7 @@ class MLPPolicy:
             x = x @ w + b
             if i < n_layers - 1:
                 x = jnp.tanh(x)
-        return x
+        return x.astype(jnp.float32)
 
     def act(self, flat_params, obs):
         """Deterministic discrete action."""
@@ -74,11 +99,13 @@ class ConvPolicy:
 
     def __init__(self, obs_shape: Tuple[int, int, int], act_dim: int,
                  channels: Sequence[int] = (16, 32),
-                 hidden: int = 128) -> None:
+                 hidden: int = 128,
+                 compute_dtype: str | None = None) -> None:
         self.obs_shape = obs_shape  # (H, W, C)
         self.act_dim = act_dim
         self.channels = tuple(channels)
         self.hidden = hidden
+        self.compute_dtype = compute_dtype
         h, w, c = obs_shape
         self._specs = []
         in_c = c
@@ -113,7 +140,11 @@ class ConvPolicy:
         import jax.numpy as jnp
         import numpy as np
 
+        dt = _compute_dtype(self.compute_dtype)
         x = obs[None]  # NHWC with N=1
+        if dt is not None:
+            x = x.astype(dt)
+            flat_params = flat_params.astype(dt)
         offset = 0
         n = len(self._specs)
         for i, (kind, shape) in enumerate(self._specs):
@@ -134,7 +165,7 @@ class ConvPolicy:
                 x = x @ w + b
                 if i < n - 1:
                     x = jnp.tanh(x)
-        return x[0]
+        return x[0].astype(jnp.float32)
 
     def act(self, flat_params, obs):
         import jax.numpy as jnp

@@ -53,33 +53,44 @@ def tunnel_alive() -> bool:
 
 
 def tune_sweep() -> None:
-    """Population x unroll sweep; merge the best point into
-    RUNS/tune_es.json (bench.py reads it for its hardware defaults)."""
+    """Population x unroll x policy-dtype sweep; merge the best point
+    into RUNS/tune_es.json (bench.py reads it for its hardware
+    defaults)."""
     best = None
     for unroll in (1, 2, 4):
-        out = os.path.join("/tmp", f"tune_u{unroll}.json")
-        rc, tail = run(
-            [sys.executable, "examples/tune_es.py",
-             "--pops", "4096,8192,16384", "--gens", "5", "--json", out],
-            timeout=1500, env={"FIBER_ROLLOUT_UNROLL": str(unroll)})
-        log(f"tune unroll={unroll}: rc={rc}")
-        if rc != 0:
-            continue
-        try:
-            with open(out) as fh:
-                data = json.load(fh)
-        except (OSError, ValueError):
-            continue
-        if data.get("platform") != "tpu":
-            continue
-        data["unroll"] = unroll
-        if best is None or (data["best_evals_per_sec"]
-                            > best["best_evals_per_sec"]):
-            best = data
+        for dtype in ("", "bfloat16"):
+            tag = f"u{unroll}{'_bf16' if dtype else ''}"
+            out = os.path.join("/tmp", f"tune_{tag}.json")
+            # both knobs set unconditionally ('' = unset) so inherited
+            # shell values can't mislabel a sweep arm
+            env = {"FIBER_ROLLOUT_UNROLL": str(unroll),
+                   "FIBER_POLICY_DTYPE": dtype}
+            rc, tail = run(
+                [sys.executable, "examples/tune_es.py",
+                 "--pops", "4096,8192,16384", "--gens", "5",
+                 "--json", out],
+                timeout=1500, env=env)
+            log(f"tune unroll={unroll} dtype={dtype or 'f32'}: rc={rc}")
+            if rc != 0:
+                continue
+            try:
+                with open(out) as fh:
+                    data = json.load(fh)
+            except (OSError, ValueError):
+                continue
+            if data.get("platform") != "tpu":
+                continue
+            data["unroll"] = unroll
+            if dtype:
+                data["dtype"] = dtype
+            if best is None or (data["best_evals_per_sec"]
+                                > best["best_evals_per_sec"]):
+                best = data
     if best:
         with open(os.path.join(REPO, "RUNS", "tune_es.json"), "w") as fh:
             json.dump(best, fh, indent=1)
-        log(f"tune best: pop={best['best_pop']} unroll={best['unroll']} "
+        log(f"tune best: pop={best['best_pop']} "
+            f"unroll={best['unroll']} dtype={best.get('dtype', 'f32')} "
             f"{best['best_evals_per_sec']} evals/s")
 
 

@@ -798,3 +798,30 @@ def test_poet_on_biped_walker():
             break
     assert len(poet.envs) > n_envs0, "no mutated course was admitted"
     assert len(poet.archive) > n_arch0
+
+
+def test_policy_compute_dtype_bf16():
+    """compute_dtype (kwarg or FIBER_POLICY_DTYPE env) runs policy
+    matmuls in bfloat16 while keeping a float32 boundary, without
+    changing the argmax action contract materially."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    pol32 = MLPPolicy(4, 3, hidden=(16,))
+    polbf = MLPPolicy(4, 3, hidden=(16,), compute_dtype="bfloat16")
+    params = pol32.init(jax.random.PRNGKey(0))
+    obs = jnp.asarray([0.1, -0.2, 0.3, 0.05])
+    out32 = pol32.apply(params, obs)
+    outbf = polbf.apply(params, obs)
+    assert out32.dtype == jnp.float32 and outbf.dtype == jnp.float32
+    # bf16 matmuls agree to bf16 tolerance
+    assert jnp.allclose(out32, outbf, atol=0.05), (out32, outbf)
+
+    os.environ["FIBER_POLICY_DTYPE"] = "bfloat16"
+    try:
+        out_env = MLPPolicy(4, 3, hidden=(16,)).apply(params, obs)
+    finally:
+        del os.environ["FIBER_POLICY_DTYPE"]
+    assert jnp.allclose(out_env, outbf, atol=1e-6)

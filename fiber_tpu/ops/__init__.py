@@ -10,6 +10,11 @@ from fiber_tpu.ops.collectives import (  # noqa: F401
 from fiber_tpu.ops.es import EvolutionStrategy, centered_rank  # noqa: F401
 from fiber_tpu.ops.pgpe import PGPE  # noqa: F401
 from fiber_tpu.ops.cma import SepCMAES  # noqa: F401
+from fiber_tpu.ops.novelty import (  # noqa: F401
+    NoveltyES,
+    NoveltyState,
+    knn_novelty,
+)
 from fiber_tpu.ops.poet import POET  # noqa: F401
 from fiber_tpu.ops.ring_attention import ring_attention  # noqa: F401
 from fiber_tpu.ops.ulysses_attention import ulysses_attention  # noqa: F401

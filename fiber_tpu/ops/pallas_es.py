@@ -29,7 +29,15 @@ from __future__ import annotations
 import functools
 from typing import Optional
 
-PAIR_BLOCK = 8
+# Mosaic block-shape rule: the LAST dim of every block must be 128-
+# divisible or span the whole array (and the second-to-last 8-divisible
+# likewise). The gradient kernel's weight block is (1, PAIR_BLOCK), so
+# PAIR_BLOCK must be a multiple of 128 — anything smaller only lowers
+# when it happens to equal the array dim (which is exactly how an
+# 8-wide block passed a pairs=8 self-check and then failed on real
+# population sizes). 128 also gives the w @ eps contraction a full
+# MXU-width reduction axis.
+PAIR_BLOCK = 128
 DIM_BLOCK = 512
 
 
@@ -106,6 +114,9 @@ def _perturb_kernel(seed_ref, sigma_ref, params_ref, out_ref, *,
     sign = jnp.where(i < pair_blocks, 1.0, -1.0)
     _seed_tile_prng(seed_ref, pair_block, j, dim_blocks)
     eps = _gaussian_tile(out_ref.shape)
+    # params block is (1, DIM_BLOCK) — 2-D so it carries the standard
+    # (8, 128) XLA tiling; a 1-D multi-block operand gets a T(1024)
+    # layout Mosaic can't match against a 512-wide block.
     out_ref[:] = params_ref[:] + sign * sigma_ref[0] * eps
 
 
@@ -114,6 +125,7 @@ def _wsum_kernel(seed_ref, w_ref, out_ref, *, dim_blocks):
     eps with the same seeding as the perturb pass. The pair (reduction)
     axis is the minor-most grid axis so each output block's revisits are
     contiguous (TPU accumulation-grid requirement)."""
+    import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
@@ -126,8 +138,14 @@ def _wsum_kernel(seed_ref, w_ref, out_ref, *, dim_blocks):
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    out_ref[:] += jnp.dot(
-        w_ref[:], eps, preferred_element_type=jnp.float32
+    # HIGHEST precision: the default TPU matmul runs bf16 passes, whose
+    # ~1e-2 relative error is enough to trip the regeneration self-check
+    # that gates this whole path; this contraction is pairs*dim MACs —
+    # noise next to the population rollouts.
+    out_ref[:] += jax.lax.dot_general(
+        w_ref[:], eps, (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
     )
 
 
@@ -168,7 +186,7 @@ def build_perturb(pairs: int, dim: int, sigma: Optional[float] = None,
             in_specs=[
                 pl.BlockSpec((2,), lambda i, j: (0,)),           # seed words
                 pl.BlockSpec((1,), lambda i, j: (0,)),           # sigma
-                pl.BlockSpec((DIM_BLOCK,), lambda i, j: (j,)),   # params
+                pl.BlockSpec((1, DIM_BLOCK), lambda i, j: (0, j)),  # params
             ],
             out_specs=pl.BlockSpec((PAIR_BLOCK, DIM_BLOCK),
                                    lambda i, j: (i, j)),
@@ -178,7 +196,7 @@ def build_perturb(pairs: int, dim: int, sigma: Optional[float] = None,
         )
 
         def run(params, seed, sigma_value):
-            params_p = jnp.zeros((pad_dim,), jnp.float32).at[:dim].set(
+            params_p = jnp.zeros((1, pad_dim), jnp.float32).at[0, :dim].set(
                 params)
             seed_arr = jnp.asarray(seed, jnp.int32).reshape(2)
             sigma_arr = jnp.asarray([sigma_value], jnp.float32)
@@ -253,22 +271,36 @@ def pallas_available() -> bool:
             _SELF_CHECK = False
             return False
         seed = jnp.asarray([12345, 678], jnp.int32)
-        pert = build_perturb(PAIR_BLOCK, DIM_BLOCK, 1.0)
-        thetas = pert(jnp.zeros((DIM_BLOCK,), jnp.float32), seed)
-        eps = jax.device_get(thetas[:PAIR_BLOCK])
+        # MULTI-block shapes on purpose: single-block specs are exempt
+        # from Mosaic's divisibility rules, so a one-block self-check
+        # can pass while real population sizes fail to lower (that was
+        # a live bug: an 8-wide weight block checked green at pairs=8,
+        # then crashed every real bench). Odd sizes also exercise the
+        # padding path.
+        pairs = 2 * PAIR_BLOCK + 1
+        dim = DIM_BLOCK + 3
+        pert = build_perturb(pairs, dim, 1.0)
+        thetas = pert(jnp.zeros((dim,), jnp.float32), seed)
+        eps = jax.device_get(thetas[:pairs])
         noise_ok = (
             abs(float(eps.mean())) < 0.2
             and 0.8 < float(eps.std()) < 1.2
-            and bool(jnp.allclose(thetas[:PAIR_BLOCK],
-                                  -thetas[PAIR_BLOCK:], atol=1e-5))
+            and bool(jnp.allclose(thetas[:pairs],
+                                  -thetas[pairs:], atol=1e-5))
         )
         # The gradient kernel must regenerate the SAME noise the perturb
         # pass evaluated, or ES gradients are silently wrong: check
         # w @ eps against the perturb output.
-        w = jnp.linspace(-1.0, 1.0, PAIR_BLOCK)
-        g = build_weighted_eps_sum(PAIR_BLOCK, DIM_BLOCK)(w, seed)
-        g_ref = w @ thetas[:PAIR_BLOCK]
-        grad_ok = bool(jnp.allclose(g, g_ref, atol=1e-3 * DIM_BLOCK**0.5))
+        import numpy as np
+
+        w = jnp.linspace(-1.0, 1.0, pairs)
+        g = build_weighted_eps_sum(pairs, dim)(w, seed)
+        # Host float64 reference: a device-side w @ thetas would carry
+        # its own bf16 matmul error and make the gate flaky.
+        g_ref = (np.asarray(jax.device_get(w), np.float64)
+                 @ np.asarray(jax.device_get(thetas[:pairs]), np.float64))
+        grad_ok = bool(np.allclose(np.asarray(jax.device_get(g)), g_ref,
+                                   atol=1e-4 * pairs**0.5))
         _SELF_CHECK = noise_ok and grad_ok
     except Exception:
         _SELF_CHECK = False

@@ -819,9 +819,114 @@ def test_policy_compute_dtype_bf16():
     # bf16 matmuls agree to bf16 tolerance
     assert jnp.allclose(out32, outbf, atol=0.05), (out32, outbf)
 
+    prev = os.environ.get("FIBER_POLICY_DTYPE")
     os.environ["FIBER_POLICY_DTYPE"] = "bfloat16"
     try:
         out_env = MLPPolicy(4, 3, hidden=(16,)).apply(params, obs)
     finally:
-        del os.environ["FIBER_POLICY_DTYPE"]
+        if prev is None:
+            del os.environ["FIBER_POLICY_DTYPE"]
+        else:
+            os.environ["FIBER_POLICY_DTYPE"] = prev
     assert jnp.allclose(out_env, outbf, atol=1e-6)
+
+
+def test_knn_novelty_matches_numpy():
+    """Device k-NN novelty (matmul distance + top_k + ring liveness
+    mask) must agree with a straightforward numpy computation, both
+    with a partially-filled and a fully-live archive."""
+    import jax
+    import jax.numpy as jnp
+
+    from fiber_tpu.ops import knn_novelty
+
+    rng = np.random.RandomState(0)
+    bcs = rng.randn(7, 3).astype(np.float32)
+    archive = rng.randn(16, 3).astype(np.float32)
+    for count, k in [(5, 3), (16, 4), (2, 10), (40, 4)]:
+        got = np.asarray(jax.device_get(
+            knn_novelty(jnp.asarray(bcs), jnp.asarray(archive),
+                        jnp.asarray(count, jnp.int32), k)))
+        live = archive[: min(count, 16)]
+        want = []
+        for b in bcs:
+            d = np.sort(np.linalg.norm(live - b, axis=1))
+            kk = min(k, len(d))
+            want.append(d[:kk].mean())
+        assert np.allclose(got, np.asarray(want), atol=1e-4), (count, k)
+
+
+def test_novelty_es_modes_and_archive():
+    """NSR-ES on a quadratic: improves fitness; the archive ring fills
+    and wraps; with reward_weight=1 it matches plain-ES behavior
+    (fitness-only blend); NS-ES (w=0) grows behavior coverage."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from fiber_tpu.ops import NoveltyES
+
+    target = jnp.asarray([0.6, -0.4])
+
+    def eval_fn(theta, key):
+        # Behavior characterization IS the parameter point (2-D).
+        return -jnp.sum((theta - target) ** 2), theta
+
+    mesh = Mesh(np.asarray(jax.devices()), ("pool",))
+    nes = NoveltyES(eval_fn, dim=2, bc_dim=2, pop_size=64,
+                    sigma=0.1, lr=0.2, mesh=mesh,
+                    archive_size=8, k=3, reward_weight=0.5)
+    key = jax.random.PRNGKey(0)
+    state = nes.init_state(jnp.zeros(2), key)
+    assert int(state.count) == 1
+    f0 = float(eval_fn(state.params, key)[0])
+    state, history = nes.run(state, jax.random.PRNGKey(1), 20)
+    f1 = float(eval_fn(state.params, key)[0])
+    assert f1 > f0, (f0, f1)
+    # 20 admissions into an 8-slot ring: count keeps the true total,
+    # the ring holds the last 8.
+    assert int(state.count) == 21
+    final = np.asarray(jax.device_get(history[-1]))
+    assert np.isfinite(final).all()
+    # stats = [mean_fit, max_fit, mean_novelty, w]; w stayed fixed
+    assert abs(float(final[3]) - 0.5) < 1e-6
+
+
+def test_novelty_es_nsra_weight_adapts():
+    """NSRA-ES: on a flat fitness landscape w anneals DOWN (toward
+    novelty) after `patience` stagnant generations; on an improving
+    landscape w anneals UP."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from fiber_tpu.ops import NoveltyES
+
+    mesh = Mesh(np.asarray(jax.devices()), ("pool",))
+
+    def flat_eval(theta, key):
+        return jnp.asarray(0.0), theta
+
+    nes = NoveltyES(flat_eval, dim=2, bc_dim=2, pop_size=32,
+                    mesh=mesh, archive_size=8, k=3,
+                    reward_weight=0.8, adaptive=True,
+                    weight_delta=0.1, patience=3)
+    state = nes.init_state(jnp.zeros(2), jax.random.PRNGKey(0))
+    # Gen 1 always "improves" (best starts at -inf) -> w: 0.8 -> 0.9;
+    # then constant fitness stagnates: every `patience` gens w drops.
+    state, _ = nes.run(state, jax.random.PRNGKey(1), 11)
+    # 1 up-step then 3 down-steps over 10 stagnant gens
+    assert abs(float(state.w) - 0.6) < 1e-5, float(state.w)
+
+    def improving_eval(theta, key):
+        # Fitness grows with |theta|: ES pushes outward, max keeps
+        # setting records -> w anneals up.
+        return jnp.sum(theta * theta), theta
+
+    nes2 = NoveltyES(improving_eval, dim=2, bc_dim=2, pop_size=32,
+                     mesh=mesh, archive_size=8, k=3,
+                     reward_weight=0.2, adaptive=True,
+                     weight_delta=0.1, patience=50)
+    state2 = nes2.init_state(jnp.ones(2), jax.random.PRNGKey(0))
+    state2, _ = nes2.run(state2, jax.random.PRNGKey(1), 6)
+    assert float(state2.w) > 0.2 + 0.25, float(state2.w)

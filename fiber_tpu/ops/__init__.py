@@ -9,7 +9,7 @@ from fiber_tpu.ops.collectives import (  # noqa: F401
 )
 from fiber_tpu.ops.es import EvolutionStrategy, centered_rank  # noqa: F401
 from fiber_tpu.ops.pgpe import PGPE  # noqa: F401
-from fiber_tpu.ops.cma import SepCMAES  # noqa: F401
+from fiber_tpu.ops.cma import SepCMAES, CMAES  # noqa: F401
 from fiber_tpu.ops.novelty import (  # noqa: F401
     NoveltyES,
     NoveltyState,

@@ -1,22 +1,31 @@
-"""Separable CMA-ES (diagonal covariance) on the SPMD mesh skeleton.
+"""CMA-ES family (separable and full-covariance) on the SPMD mesh
+skeleton.
 
-Third member of the ES algorithm family (OpenAI-ES in ``es.py``, PGPE in
+Members of the ES algorithm family (OpenAI-ES in ``es.py``, PGPE in
 ``pgpe.py``), sharing the same contract: ``eval_fn(flat_params, key) ->
 scalar fitness`` (maximized), population sampled per device, fitness
-all-gathered, and every moment the update needs reduced with ``(dim,)``
-psums — no candidate matrix ever crosses the ICI.
+all-gathered, and the update moments reduced with psums.
 
-sep-CMA-ES (Ros & Hansen 2008) restricts CMA's covariance to the
-diagonal: updates cost O(dim) per generation instead of O(dim^2), which
-is the only variant that makes sense at neuroevolution scale — and the
-diagonal makes the whole update elementwise, exactly what the VPU wants.
-The selection step needs no gather of candidates: each device weights
-its own (pop/n_dev, dim) sample block by the globally-ranked weights of
-its slice and contributes three partial sums (w·y, w·z, w·y²).
+* ``SepCMAES`` (Ros & Hansen 2008) restricts CMA's covariance to the
+  diagonal: updates cost O(dim) per generation instead of O(dim^2) —
+  the only variant that makes sense at neuroevolution scale, and the
+  diagonal makes the whole update elementwise, exactly what the VPU
+  wants. The selection step needs no gather of candidates: each device
+  weights its own (pop/n_dev, dim) sample block by the globally-ranked
+  weights of its slice and contributes ``(dim,)`` partial sums
+  (w·y, w·z, w·y²) — no candidate matrix ever crosses the ICI.
+* ``CMAES`` is Hansen's standard full-covariance formulation for the
+  low-dimensional regime (controllers, tuners) where *correlated*
+  search distributions matter; it adds a replicated eigh and one
+  ``(dim, dim)`` psum per generation.
+
+Both run the same jitted SPMD generation (``_CMABase._build_step``);
+the variants differ only in four hooks: covariance preparation,
+sampling, the ``C^{-1/2}`` projection, and the covariance update.
 
 Reference capability anchor: the ES loop the reference's gecco-2020
 example drives through fiber.Pool (/root/reference/examples/gecco-2020/
-es.py) — same role, different algorithm member.
+es.py) — same role, different algorithm members.
 """
 
 from __future__ import annotations
@@ -25,18 +34,33 @@ import math
 from typing import Callable, Tuple
 
 
-class SepCMAES:
-    """Diagonal CMA-ES. ``state = (m, sigma, C, p_sigma, p_c, gen)``;
-    ``step(state, key) -> (state, stats)`` with stats =
-    [mean_fitness, max_fitness, sigma]."""
+class _CMABase:
+    """Shared CMA-ES machinery: population quantization over the mesh,
+    Hansen's default strategy constants, and the full jitted SPMD
+    generation. Subclasses supply the covariance model through four
+    pure hooks (called inside the traced step):
+
+    * ``_prep_cov(C) -> (C_prep, aux)`` — per-generation factorization
+      (identity for the diagonal model, eigh for the full model);
+    * ``_sample(z, C_prep, aux) -> y`` — map N(0, I) draws to N(0, C);
+    * ``_whiten(zw, aux) -> C^{-1/2}<y>_w`` — the step-size path input;
+    * ``_cov_moment(w_local, y)`` / ``_cov_update(C_prep, moment, p_c,
+      h_sigma) -> new_C`` — the rank-mu moment (psum'd by the base; its
+      shape is the model's parameter count) and the covariance update.
+
+    ``sep_scaling=True`` applies the separable model's learning-rate
+    boost — dim (not dim^2) covariance parameters support rates
+    (n+2)/3 higher (Ros & Hansen 2008).
+    """
 
     def __init__(
         self,
         eval_fn: Callable,
         dim: int,
         pop_size: int,
-        sigma_init: float = 0.3,
-        mesh=None,
+        sigma_init: float,
+        mesh,
+        sep_scaling: bool,
     ) -> None:
         import numpy as np
 
@@ -71,23 +95,47 @@ class SepCMAES:
         c1 = 2.0 / ((n + 1.3) ** 2 + me)
         cmu = min(1.0 - c1,
                   2.0 * (me - 2.0 + 1.0 / me) / ((n + 2.0) ** 2 + me))
-        # The separable model has dim (not dim^2) covariance parameters,
-        # so its learning rates scale up by (n+2)/3 (Ros & Hansen 2008).
-        sep = (n + 2.0) / 3.0
-        self.c_1 = min(1.0, c1 * sep)
-        self.c_mu = min(1.0 - self.c_1, cmu * sep)
+        if sep_scaling:
+            sep = (n + 2.0) / 3.0
+            self.c_1 = min(1.0, c1 * sep)
+            self.c_mu = min(1.0 - self.c_1, cmu * sep)
+        else:
+            self.c_1 = c1
+            self.c_mu = cmu
         self.chi_n = math.sqrt(n) * (1.0 - 1.0 / (4.0 * n)
                                      + 1.0 / (21.0 * n * n))
         self._step = self._build_step()
 
+    # -- covariance-model hooks (pure; traced inside the step) ----------
+    def _prep_cov(self, C):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _sample(self, z, C_prep, aux):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _whiten(self, zw, aux):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _cov_moment(self, w_local, y):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _cov_update(self, C_prep, moment, p_c, h_sigma):
+        raise NotImplementedError  # pragma: no cover - abstract
+
+    def _init_cov(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
     def init_state(self, m0=None) -> Tuple:
+        """``(m, sigma, C, p_sigma, p_c, gen)`` starting state; ``m0``
+        defaults to zeros."""
         import jax.numpy as jnp
 
         m = jnp.zeros((self.dim,)) if m0 is None else jnp.asarray(m0)
         if m.shape != (self.dim,):
             raise ValueError(f"m0 shape {m.shape} != ({self.dim},)")
         z = jnp.zeros((self.dim,))
-        return (m, jnp.asarray(self.sigma_init), jnp.ones((self.dim,)),
+        return (m, jnp.asarray(self.sigma_init), self._init_cov(),
                 z, z, jnp.asarray(0, jnp.int32))
 
     def _build_step(self):
@@ -102,7 +150,7 @@ class SepCMAES:
         dim = self.dim
         mu = self.mu
         c_sigma, d_sigma = self.c_sigma, self.d_sigma
-        c_c, c_1, c_mu = self.c_c, self.c_1, self.c_mu
+        c_c = self.c_c
         mu_eff, chi_n = self.mu_eff, self.chi_n
         w_table = jnp.zeros((lam,)).at[:mu].set(jnp.asarray(self.weights))
 
@@ -111,8 +159,9 @@ class SepCMAES:
             dev_key = jax.random.fold_in(key, my)
             z_key, eval_key = jax.random.split(dev_key)
 
+            C_prep, aux = self._prep_cov(C)
             z = jax.random.normal(z_key, (lam_dev, dim))
-            y = jnp.sqrt(C) * z
+            y = self._sample(z, C_prep, aux)             # (lam_dev, dim)
             thetas = m + sigma * y
             eval_keys = jax.random.split(eval_key, lam_dev)
             fitness = jax.vmap(eval_fn)(thetas, eval_keys)
@@ -126,12 +175,12 @@ class SepCMAES:
                 w_full, my * lam_dev, lam_dev)
 
             yw = jax.lax.psum(w_local @ y, "pool")       # <y>_w
-            zw = jax.lax.psum(w_local @ z, "pool")       # C^-1/2 <y>_w
-            y2w = jax.lax.psum(w_local @ (y * y), "pool")
+            zw = jax.lax.psum(w_local @ z, "pool")
+            moment = jax.lax.psum(self._cov_moment(w_local, y), "pool")
 
             p_sigma = ((1.0 - c_sigma) * p_sigma
                        + math.sqrt(c_sigma * (2.0 - c_sigma) * mu_eff)
-                       * zw)
+                       * self._whiten(zw, aux))          # C^-1/2 <y>_w
             norm_ps = jnp.linalg.norm(p_sigma)
             decay = 1.0 - (1.0 - c_sigma) ** (2.0 * (gen + 1.0))
             h_sigma = jnp.where(
@@ -142,11 +191,7 @@ class SepCMAES:
                    * yw)
 
             new_m = m + sigma * yw
-            new_C = ((1.0 - c_1 - c_mu) * C
-                     + c_1 * (p_c * p_c
-                              + (1.0 - h_sigma) * c_c * (2.0 - c_c) * C)
-                     + c_mu * y2w)
-            new_C = jnp.maximum(new_C, 1e-20)
+            new_C = self._cov_update(C_prep, moment, p_c, h_sigma)
             new_sigma = sigma * jnp.exp(
                 (c_sigma / d_sigma) * (norm_ps / chi_n - 1.0))
 
@@ -165,6 +210,8 @@ class SepCMAES:
         return jax.jit(stepped)
 
     def step(self, state, key):
+        """One generation: ``(state, stats)`` with stats =
+        [mean_fitness, max_fitness, sigma]."""
         out = self._step(*state, key)
         return out[:-1], out[-1]
 
@@ -172,3 +219,111 @@ class SepCMAES:
         from fiber_tpu.ops.es import run_steps
 
         return run_steps(self.step, state, key, generations)
+
+
+class SepCMAES(_CMABase):
+    """Diagonal CMA-ES. ``state = (m, sigma, C, p_sigma, p_c, gen)``
+    with ``C`` the ``(dim,)`` covariance diagonal."""
+
+    def __init__(
+        self,
+        eval_fn: Callable,
+        dim: int,
+        pop_size: int,
+        sigma_init: float = 0.3,
+        mesh=None,
+    ) -> None:
+        super().__init__(eval_fn, dim, pop_size, sigma_init, mesh,
+                         sep_scaling=True)
+
+    def _init_cov(self):
+        import jax.numpy as jnp
+
+        return jnp.ones((self.dim,))
+
+    def _prep_cov(self, C):
+        return C, None
+
+    def _sample(self, z, C, aux):
+        import jax.numpy as jnp
+
+        return jnp.sqrt(C) * z
+
+    def _whiten(self, zw, aux):
+        return zw                                        # C^-1/2 y = z
+
+    def _cov_moment(self, w_local, y):
+        return w_local @ (y * y)                         # (dim,)
+
+    def _cov_update(self, C, y2w, p_c, h_sigma):
+        import jax.numpy as jnp
+
+        new_C = ((1.0 - self.c_1 - self.c_mu) * C
+                 + self.c_1 * (p_c * p_c
+                               + (1.0 - h_sigma) * self.c_c
+                               * (2.0 - self.c_c) * C)
+                 + self.c_mu * y2w)
+        return jnp.maximum(new_C, 1e-20)
+
+
+class CMAES(_CMABase):
+    """Full-covariance CMA-ES. ``state = (m, sigma, C (dim, dim),
+    p_sigma, p_c, gen)``.
+
+    The full (dim, dim) covariance learns *correlated* search
+    distributions — rotated/ill-conditioned objectives where the
+    diagonal model (``SepCMAES``) stalls — at O(dim^2) memory and an
+    O(dim^3) eigendecomposition per generation, so it is the
+    low-dimensional member of the family (controllers, tuners; use
+    SepCMAES or OpenAI-ES for network-scale dim). TPU mapping: sampling
+    is ``z @ (B·D)^T`` and the rank-mu update is ``y^T diag(w) y`` —
+    two (lam_dev, dim)×(dim, dim) MXU contractions per device; the
+    (dim, dim) partial sums ride one psum; the eigh runs replicated
+    (it's O(dim^3) but dim is small by charter).
+    """
+
+    def __init__(
+        self,
+        eval_fn: Callable,
+        dim: int,
+        pop_size: int,
+        sigma_init: float = 0.3,
+        mesh=None,
+    ) -> None:
+        super().__init__(eval_fn, dim, pop_size, sigma_init, mesh,
+                         sep_scaling=False)
+
+    def _init_cov(self):
+        import jax.numpy as jnp
+
+        return jnp.eye(self.dim)
+
+    def _prep_cov(self, C):
+        import jax.numpy as jnp
+
+        # Replicated eigendecomposition: C = B diag(D^2) B^T.
+        C_sym = 0.5 * (C + C.T)
+        eigval, B = jnp.linalg.eigh(C_sym)
+        D = jnp.sqrt(jnp.maximum(eigval, 1e-20))         # (dim,)
+        return C_sym, (B, D)
+
+    def _sample(self, z, C_sym, aux):
+        B, D = aux
+        # y_i = B D z_i — one MXU contraction for the whole block.
+        return (z * D) @ B.T
+
+    def _whiten(self, zw, aux):
+        B, _ = aux
+        return B @ zw                                    # C^-1/2<y>_w
+
+    def _cov_moment(self, w_local, y):
+        return y.T @ (w_local[:, None] * y)              # (dim, dim)
+
+    def _cov_update(self, C_sym, ywyT, p_c, h_sigma):
+        import jax.numpy as jnp
+
+        return ((1.0 - self.c_1 - self.c_mu) * C_sym
+                + self.c_1 * (jnp.outer(p_c, p_c)
+                              + (1.0 - h_sigma) * self.c_c
+                              * (2.0 - self.c_c) * C_sym)
+                + self.c_mu * ywyT)

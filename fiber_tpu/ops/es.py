@@ -97,6 +97,13 @@ class EvolutionStrategy:
             self.use_pallas = pallas_available()
         else:
             self.use_pallas = bool(use_pallas)
+        # NOTE: pairs_per_dev is NOT rounded up to the pallas
+        # PAIR_BLOCK. Alignment would give the kernel's zero-repack
+        # fast path, but inflating the population multiplies rollout
+        # cost (the dominant term for this library's eval_fns) by up
+        # to PAIR_BLOCK×/device on small-pop configs — one padded
+        # (pop, dim) HBM repack is far cheaper. Pops that are already
+        # PAIR_BLOCK-aligned per device take the fast path naturally.
         self._step = self._build_step()
 
     # ------------------------------------------------------------------

@@ -203,6 +203,10 @@ def build_perturb(pairs: int, dim: int, sigma: Optional[float] = None,
             out = call(seed_arr, sigma_arr, params_p)
             if pad_pairs == pairs and pad_dim == dim:
                 return out  # already exactly [plus; minus] — zero copies
+            if pad_pairs == pairs:
+                # Pair axis exact (EvolutionStrategy aligns it to
+                # PAIR_BLOCK): one dim-axis slice, no antithetic repack.
+                return out[:, :dim]
             plus = out[:pairs, :dim]
             minus = out[pad_pairs:pad_pairs + pairs, :dim]
             return jnp.concatenate([plus, minus], axis=0)

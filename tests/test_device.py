@@ -930,3 +930,65 @@ def test_novelty_es_nsra_weight_adapts():
     state2 = nes2.init_state(jnp.ones(2), jax.random.PRNGKey(0))
     state2, _ = nes2.run(state2, jax.random.PRNGKey(1), 6)
     assert float(state2.w) > 0.2 + 0.25, float(state2.w)
+
+
+def test_full_cma_es_learns_rotated_ellipsoid():
+    """Full-covariance CMA-ES on a rotated ill-conditioned quadratic:
+    converges AND the learned covariance picks up the off-diagonal
+    correlation that defines the rotated objective (the structure the
+    diagonal SepCMAES model cannot represent)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from fiber_tpu.ops import CMAES
+
+    # 45-degree-rotated ellipsoid, condition number 100.
+    c, s = np.cos(np.pi / 4), np.sin(np.pi / 4)
+    R = jnp.asarray([[c, -s], [s, c]])
+    H = R @ jnp.diag(jnp.asarray([1.0, 100.0])) @ R.T
+    target = jnp.asarray([0.3, -0.2])
+
+    def eval_fn(theta, key):
+        d = theta - target
+        return -d @ H @ d
+
+    mesh = Mesh(np.asarray(jax.devices()), ("pool",))
+    cma = CMAES(eval_fn, dim=2, pop_size=32, sigma_init=0.5, mesh=mesh)
+    state = cma.init_state()
+    d0 = float(-eval_fn(state[0], None))
+    # 20 generations: converged to float32 resolution but not yet past
+    # it (once every candidate ties at fitness 0, rank weights are
+    # noise and C random-walks — asserting later would test noise).
+    state, history = cma.run(state, jax.random.PRNGKey(0), 20)
+    m, sigma, C = state[0], state[1], state[2]
+    d1 = float(-eval_fn(m, None))
+    assert d1 < d0 * 1e-3, (d0, d1)
+    # The search distribution must align with H^-1, which for this H
+    # (negative off-diagonal) has strong POSITIVE correlation (+0.98):
+    # the distribution elongates along the valley.
+    corr = float(C[0, 1] / jnp.sqrt(C[0, 0] * C[1, 1]))
+    assert corr > 0.5, corr
+    final = np.asarray(jax.device_get(history[-1]))
+    assert np.isfinite(final).all()
+
+
+def test_full_cma_es_trains_cartpole():
+    """CMAES slots into the same policy-rollout contract as the rest of
+    the family (small-dim controller regime)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from fiber_tpu.ops import CMAES
+
+    policy = MLPPolicy(CartPole.obs_dim, CartPole.act_dim, hidden=(4,))
+
+    def eval_fn(theta, key):
+        return CartPole.rollout(policy.act, theta, key, max_steps=60)
+
+    mesh = Mesh(np.asarray(jax.devices()), ("pool",))
+    cma = CMAES(eval_fn, dim=policy.dim, pop_size=32, mesh=mesh)
+    state = cma.init_state(policy.init(jax.random.PRNGKey(0)))
+    state, history = cma.run(state, jax.random.PRNGKey(1), 3)
+    final = np.asarray(jax.device_get(history[-1]))
+    assert np.isfinite(final).all()

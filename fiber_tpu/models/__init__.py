@@ -8,6 +8,7 @@ from fiber_tpu.models.policies import (  # noqa: F401
 )
 from fiber_tpu.models.envs import (  # noqa: F401
     CartPole,
+    DeceptiveMaze,
     ParamBipedWalker,
     ParamCartPole,
     ParamHillWalker,

@@ -344,6 +344,82 @@ class PixelChase:
         return total
 
 
+class DeceptiveMaze:
+    """Deceptive point maze — the novelty-search lineage's canonical
+    domain (NS-ES/NSR-ES were demonstrated on mazes where the fitness
+    gradient points into a wall, so reaching the goal requires first
+    moving AWAY from it).
+
+    A point agent starts at the origin; the goal sits directly above,
+    behind a wall spanning ``|x| <= WALL_HALF`` at ``y = WALL_Y``.
+    Greedy distance-minimization presses into the middle of the wall;
+    the only way through is around either end. Observations are the
+    position and the goal offset; actions are a continuous velocity
+    (``policy.apply`` output, tanh-squashed). ``rollout_xy`` returns
+    the final position — callers derive fitness (negative goal
+    distance) and the behavior characterization (the position itself,
+    the paper's BC) from it.
+    """
+
+    obs_dim = 4
+    act_dim = 2  # (vx, vy), tanh-squashed continuous
+    max_steps = 64
+
+    GOAL = (0.0, 2.0)
+    SPEED = 0.15
+    WALL_Y = 1.0
+    WALL_HALF = 1.0
+
+    @classmethod
+    def rollout_xy(cls, apply_fn, flat_params, key,
+                   max_steps: int | None = None):
+        """Final (x, y) after ``max_steps`` of policy-driven motion;
+        jittable and vmappable."""
+        import jax
+        import jax.numpy as jnp
+
+        steps = max_steps or cls.max_steps
+        pos0 = 0.05 * jax.random.normal(key, (2,))
+        gx, gy = cls.GOAL
+
+        def scan_step(pos, _):
+            obs = jnp.stack([pos[0], pos[1], gx - pos[0], gy - pos[1]])
+            v = jnp.tanh(apply_fn(flat_params, obs)) * cls.SPEED
+            new = pos + v
+            # The wall blocks any step whose path crosses WALL_Y inside
+            # |x| <= WALL_HALF. The test point is the x where the
+            # segment intersects the wall plane (NOT the endpoint x —
+            # that would let diagonal steps cut the corner by up to
+            # SPEED). Park blocked steps just on the starting side.
+            dy = new[1] - pos[1]
+            t = jnp.where(jnp.abs(dy) > 1e-12,
+                          (cls.WALL_Y - pos[1]) / jnp.where(
+                              jnp.abs(dy) > 1e-12, dy, 1.0),
+                          2.0)  # parallel to wall: no crossing (t>1)
+            x_cross = pos[0] + t * (new[0] - pos[0])
+            crosses = (t >= 0.0) & (t <= 1.0) \
+                & (jnp.abs(x_cross) <= cls.WALL_HALF)
+            stop_y = jnp.where(pos[1] < cls.WALL_Y,
+                               cls.WALL_Y - 1e-3, cls.WALL_Y + 1e-3)
+            new_y = jnp.where(crosses, stop_y, new[1])
+            return jnp.stack([new[0], new_y]), None
+
+        pos, _ = jax.lax.scan(
+            scan_step, pos0, None, length=steps, unroll=_scan_unroll()
+        )
+        return pos
+
+    @classmethod
+    def rollout(cls, apply_fn, flat_params, key,
+                max_steps: int | None = None):
+        """Fitness-only rollout: negative final distance to the goal."""
+        import jax.numpy as jnp
+
+        pos = cls.rollout_xy(apply_fn, flat_params, key, max_steps)
+        goal = jnp.asarray(cls.GOAL)
+        return -jnp.sqrt(jnp.sum((pos - goal) ** 2))
+
+
 def _angle_normalize(x):
     import jax.numpy as jnp
 

@@ -204,8 +204,9 @@ def build_perturb(pairs: int, dim: int, sigma: Optional[float] = None,
             if pad_pairs == pairs and pad_dim == dim:
                 return out  # already exactly [plus; minus] — zero copies
             if pad_pairs == pairs:
-                # Pair axis exact (EvolutionStrategy aligns it to
-                # PAIR_BLOCK): one dim-axis slice, no antithetic repack.
+                # Pair axis happens to be PAIR_BLOCK-aligned (big pops;
+                # NOT guaranteed — see the NOTE in es.py): one dim-axis
+                # slice, no antithetic repack.
                 return out[:, :dim]
             plus = out[:pairs, :dim]
             minus = out[pad_pairs:pad_pairs + pairs, :dim]

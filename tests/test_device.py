@@ -992,3 +992,35 @@ def test_full_cma_es_trains_cartpole():
     state, history = cma.run(state, jax.random.PRNGKey(1), 3)
     final = np.asarray(jax.device_get(history[-1]))
     assert np.isfinite(final).all()
+
+
+def test_deceptive_maze_contract():
+    """The maze wall blocks crossing inside its span and admits passage
+    around the ends; greedy goal-seeking therefore pins at the wall."""
+    import jax
+    import jax.numpy as jnp
+
+    from fiber_tpu.models import DeceptiveMaze
+
+    # A "policy" that always drives straight up ignores params/obs.
+    def straight_up(_params, _obs):
+        return jnp.asarray([0.0, 10.0])  # tanh -> (0, 1) * SPEED
+
+    pos = jax.device_get(DeceptiveMaze.rollout_xy(
+        straight_up, jnp.zeros(1), jax.random.PRNGKey(0)))
+    # Blocked: parked just below the wall.
+    assert abs(float(pos[1]) - DeceptiveMaze.WALL_Y) < 0.01, pos
+
+    # A shallow diagonal crosses the wall plane beyond its end
+    # (x_cross ≈ 1.3 > WALL_HALF) and keeps rising.
+    def diagonal(_params, _obs):
+        return jnp.asarray([10.0, 1.0])
+
+    pos2 = jax.device_get(DeceptiveMaze.rollout_xy(
+        diagonal, jnp.zeros(1), jax.random.PRNGKey(0)))
+    assert float(pos2[1]) > DeceptiveMaze.WALL_Y + 0.5, pos2
+
+    # Fitness rollout is the negative goal distance of the same path.
+    f = float(jax.device_get(DeceptiveMaze.rollout(
+        straight_up, jnp.zeros(1), jax.random.PRNGKey(0))))
+    assert -1.1 < f < -0.9, f

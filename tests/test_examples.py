@@ -37,3 +37,12 @@ def test_poet_distributed_example():
     )
     assert "pairs co-evolved" in out
     assert "iter 1:" in out
+
+
+def test_novelty_maze_example():
+    """NS-family demo on the deceptive maze (small config)."""
+    out = _run("novelty_maze.py", "--pop", "64", "--gens", "4",
+               timeout=480)
+    assert "plain ES" in out
+    assert "NSRA-ES" in out
+    assert "novelty search done" in out

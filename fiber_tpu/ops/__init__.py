@@ -12,6 +12,7 @@ from fiber_tpu.ops.pgpe import PGPE  # noqa: F401
 from fiber_tpu.ops.cma import SepCMAES, CMAES  # noqa: F401
 from fiber_tpu.ops.novelty import (  # noqa: F401
     NoveltyES,
+    NoveltyPopulation,
     NoveltyState,
     knn_novelty,
 )

@@ -89,12 +89,21 @@ class EvolutionStrategy:
         self.pairs_per_dev = self.pop_size // quantum
         self._fused_cache: dict = {}
         # Pallas fused-noise path: regenerate eps instead of storing it
-        # (fiber_tpu/ops/pallas_es.py). "auto" engages it only on TPU and
-        # only after a runtime noise-quality self-check.
+        # (fiber_tpu/ops/pallas_es.py). "auto" engages it only on TPU,
+        # only after the noise-quality self-check passes, AND only if a
+        # timed race at THIS instance's (pairs, dim) says the fused
+        # path beats plain jnp — correctness alone must not gate in a
+        # kernel whose sequential grid can lose to XLA's fused RNG.
         if use_pallas == "auto":
-            from fiber_tpu.ops.pallas_es import pallas_available
+            from fiber_tpu.ops.pallas_es import (
+                pallas_available,
+                pallas_wins,
+            )
 
-            self.use_pallas = pallas_available()
+            self.use_pallas = (
+                pallas_available()
+                and pallas_wins(self.pairs_per_dev, dim, self.sigma)
+            )
         else:
             self.use_pallas = bool(use_pallas)
         # NOTE: pairs_per_dev is NOT rounded up to the pallas

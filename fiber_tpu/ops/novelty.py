@@ -283,3 +283,102 @@ class NoveltyES:
         from fiber_tpu.ops.es import run_steps
 
         return run_steps(self.step, state, key, generations)
+
+
+class NoveltyPopulation:
+    """Meta-population NS-ES — the paper's actual algorithm shape ("a
+    *population* of novelty-seeking agents"): M agents share ONE
+    behavior archive; each iteration selects an agent with probability
+    proportional to the novelty of its current behavior (novel agents
+    get more optimization budget) and advances it one ``NoveltyES``
+    generation against the shared archive.
+
+    Orchestration is host-side and tiny (M is single digits); every
+    generation itself stays the one compiled SPMD step. The shared
+    archive/count are threaded through the selected agent's state, so
+    all agents see every behavior any of them has reached.
+    """
+
+    def __init__(self, nes: NoveltyES, m: int) -> None:
+        import jax
+
+        if m < 1:
+            raise ValueError(f"need m >= 1 agents, got {m}")
+        self.nes = nes
+        self.m = int(m)
+        self._states: list = []
+        # One persistent jitted eval — a fresh jax.jit per call would
+        # retrace the rollout m times every step.
+        self._jit_eval = jax.jit(nes.eval_fn)
+
+    def init(self, params0_list, key) -> None:
+        """One starting parameter vector per agent (list of length m).
+        Each agent's behavior seeds the shared archive."""
+        import jax
+
+        if len(params0_list) != self.m:
+            raise ValueError(
+                f"need {self.m} parameter vectors, got "
+                f"{len(params0_list)}"
+            )
+        keys = jax.random.split(key, self.m)
+        self._states = [
+            self.nes.init_state(p, k)
+            for p, k in zip(params0_list, keys)
+        ]
+        # Merge the seed behaviors into one shared archive: agent i's
+        # seed BC sits in its own archive slot 0; fold them all into
+        # agent 0's ring and broadcast.
+        archive, count = self._states[0].archive, self._states[0].count
+        import jax.numpy as jnp
+
+        for st in self._states[1:]:
+            idx = jnp.mod(count, self.nes.archive_size)
+            archive = archive.at[idx].set(st.archive[0])
+            count = count + 1
+        self._states = [
+            st._replace(archive=archive, count=count)
+            for st in self._states
+        ]
+
+    def agent_params(self):
+        """Current parameter vectors, one per agent."""
+        return [st.params for st in self._states]
+
+    def step(self, key):
+        """Select an agent (P ∝ current-behavior novelty against the
+        shared archive) and advance it one generation. Returns
+        (selected_index, stats)."""
+        import jax
+        import jax.numpy as jnp
+
+        sel_key, eval_key, step_key = jax.random.split(key, 3)
+        shared_archive = self._states[0].archive
+        shared_count = self._states[0].count
+        # Current behavior of every agent (one rollout each — cheap
+        # next to a generation) -> novelty against the shared archive.
+        bcs = []
+        for i, st in enumerate(self._states):
+            _, bc = self._jit_eval(
+                st.params, jax.random.fold_in(eval_key, i))
+            bcs.append(bc)
+        nov = knn_novelty(jnp.stack(bcs).astype(jnp.float32),
+                          shared_archive, shared_count, self.nes.k)
+        total = nov.sum()
+        # All-zero novelty (every behavior already archived) must fall
+        # back to a UNIFORM pick — an all-zero p would deterministically
+        # select agent 0.
+        probs = jnp.where(total > 0.0,
+                          nov / jnp.maximum(total, 1e-9),
+                          jnp.full((self.m,), 1.0 / self.m))
+        sel = int(jax.random.choice(sel_key, self.m, p=probs))
+        st = self._states[sel]._replace(archive=shared_archive,
+                                        count=shared_count)
+        new_st, stats = self.nes.step(st, step_key)
+        self._states[sel] = new_st
+        # Broadcast the grown archive to every agent's view.
+        self._states = [
+            s._replace(archive=new_st.archive, count=new_st.count)
+            for s in self._states
+        ]
+        return sel, stats

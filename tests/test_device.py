@@ -1024,3 +1024,39 @@ def test_deceptive_maze_contract():
     f = float(jax.device_get(DeceptiveMaze.rollout(
         straight_up, jnp.zeros(1), jax.random.PRNGKey(0))))
     assert -1.1 < f < -0.9, f
+
+
+def test_novelty_population_shares_archive():
+    """Meta-population NS-ES: M agents share one behavior archive;
+    selection favors novel agents; stepping any agent grows every
+    agent's view of the archive."""
+    import jax
+    import jax.numpy as jnp
+
+    from fiber_tpu.ops import NoveltyES, NoveltyPopulation
+
+    def eval_fn(theta, key):
+        return -jnp.sum(theta ** 2), theta
+
+    nes = NoveltyES(eval_fn, dim=2, bc_dim=2, pop_size=32,
+                    archive_size=16, k=3, reward_weight=0.5)
+    pop = NoveltyPopulation(nes, m=3)
+    starts = [jnp.zeros(2), jnp.ones(2), -jnp.ones(2)]
+    pop.init(starts, jax.random.PRNGKey(0))
+    # 3 seed behaviors merged into the shared ring.
+    assert int(pop._states[0].count) == 3
+    assert all(int(s.count) == 3 for s in pop._states)
+
+    key = jax.random.PRNGKey(1)
+    sels = set()
+    for i in range(4):
+        key, k = jax.random.split(key)
+        sel, stats = pop.step(k)
+        sels.add(sel)
+        assert np.isfinite(np.asarray(jax.device_get(stats))).all()
+    # 4 admissions on top of the 3 seeds, visible to EVERY agent.
+    assert all(int(s.count) == 7 for s in pop._states)
+    arcs = [np.asarray(jax.device_get(s.archive)) for s in pop._states]
+    for a in arcs[1:]:
+        assert np.allclose(a, arcs[0])
+    assert len(pop.agent_params()) == 3

@@ -332,10 +332,14 @@ def main() -> int:
     # discard the ES number already measured — the one-JSON-line contract
     # holds no matter what (errors ride along in the line instead).
     if args.ab_pallas:
-        # Same workload on the OTHER noise path (auto picks the race
-        # winner for the primary run; the A/B forces the loser so both
-        # timings are recorded). pallas_speedup > 1 means the fused
-        # pallas kernels beat plain jnp here.
+        # Same workload on the OTHER noise path (auto resolves to the
+        # measured winner for the primary run; the A/B forces the other
+        # path so both timings are recorded). pallas_speedup > 1 means
+        # the fused pallas kernels beat plain jnp here. The watchdog
+        # re-arms for this leg: a wedged Mosaic compile on the flaky
+        # accelerator must still emit the already-measured primary
+        # result (the one-JSON-line contract).
+        ab_watchdog = _watchdog(args.init_timeout, dict(result))
         try:
             from fiber_tpu.ops.pallas_es import pallas_available
 
@@ -364,6 +368,8 @@ def main() -> int:
             result["pallas_speedup"] = round(t_jnp / t_pallas, 3)
         except Exception as err:  # noqa: BLE001
             result["ab_pallas_error"] = repr(err)
+        finally:
+            ab_watchdog.cancel()
 
     if not args.no_pool_bench:
         try:

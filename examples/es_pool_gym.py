@@ -1,0 +1,87 @@
+"""ES over a pool of workers evaluating a PURE-PYTHON simulator — the
+reference's actual workflow, end to end.
+
+The reference's gecco-2020 ES (its headline example) samples
+perturbations centrally and farms evaluation through
+``fiber.Pool(40).map`` of arbitrary Python — gym envs, C simulators,
+anything unpicklable by XLA (/root/reference/examples/gecco-2020/es.py).
+This example is that loop on fiber_tpu: ``AskTellES`` does the sampling
+and update as jitted device programs, and a ``Pool`` (resilient,
+error-handled) evaluates a hand-written pure-Python CartPole in worker
+processes — no jax anywhere in the eval path.
+
+When your eval IS jittable, use ``EvolutionStrategy`` instead and the
+whole generation stays on the mesh (examples/es_cartpole.py).
+
+Run:  python examples/es_pool_gym.py [--workers 4] [--pop 64] [--gens 10]
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+
+import argparse
+import math
+import random
+
+
+def simulate_cartpole(theta) -> float:
+    """Pure-Python CartPole with a linear policy — stands in for a gym
+    env: no jax, no numpy vectorization, just the kind of arbitrary
+    host code the reference's pools were built to evaluate."""
+    rng = random.Random(12345)
+    x, v, a, w = [0.02 * (rng.random() - 0.5) for _ in range(4)]
+    g, mc, mp_, lp, dt = 9.8, 1.0, 0.1, 0.5, 0.02
+    steps = 0
+    for _ in range(200):
+        obs = (x, v, a, w)
+        score = sum(t * o for t, o in zip(theta, obs))
+        force = 10.0 if score > 0 else -10.0
+        cosa, sina = math.cos(a), math.sin(a)
+        tmp = (force + mp_ * lp * w * w * sina) / (mc + mp_)
+        aacc = (g * sina - cosa * tmp) / (
+            lp * (4.0 / 3.0 - mp_ * cosa * cosa / (mc + mp_)))
+        xacc = tmp - mp_ * lp * aacc * cosa / (mc + mp_)
+        x, v = x + dt * v, v + dt * xacc
+        a, w = a + dt * w, w + dt * aacc
+        steps += 1
+        if abs(x) > 2.4 or abs(a) > 0.209:
+            break
+    return float(steps)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--workers", type=int, default=4)
+    parser.add_argument("--pop", type=int, default=64)
+    parser.add_argument("--gens", type=int, default=10)
+    args = parser.parse_args()
+
+    import jax
+
+    import fiber_tpu
+    from fiber_tpu.ops import AskTellES
+
+    es = AskTellES(dim=4, pop_size=args.pop, sigma=0.5, lr=0.3)
+    key = jax.random.PRNGKey(0)
+
+    with fiber_tpu.Pool(args.workers) as pool:
+        for gen in range(args.gens):
+            key, k = jax.random.split(key)
+            thetas = es.ask(k)
+            fits = pool.map(simulate_cartpole,
+                            [t.tolist() for t in thetas])
+            stats = es.tell(fits)
+            print(f"gen {gen}: mean {stats['mean_fitness']:6.1f}  "
+                  f"max {stats['max_fitness']:6.1f}", flush=True)
+
+    final = simulate_cartpole([float(t) for t in es.params])
+    print(f"final policy survives {final:.0f}/200 steps")
+    print("pool-evaluated ES done")
+
+
+if __name__ == "__main__":
+    main()

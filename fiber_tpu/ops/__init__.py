@@ -7,7 +7,11 @@ from fiber_tpu.ops.collectives import (  # noqa: F401
     all_gather_sharded,
     HostRing,
 )
-from fiber_tpu.ops.es import EvolutionStrategy, centered_rank  # noqa: F401
+from fiber_tpu.ops.es import (  # noqa: F401
+    AskTellES,
+    EvolutionStrategy,
+    centered_rank,
+)
 from fiber_tpu.ops.pgpe import PGPE  # noqa: F401
 from fiber_tpu.ops.cma import SepCMAES, CMAES  # noqa: F401
 from fiber_tpu.ops.novelty import (  # noqa: F401

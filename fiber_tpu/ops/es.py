@@ -38,6 +38,29 @@ def run_steps(step, state, key, generations: int):
     return state, history
 
 
+def apply_es_update(params, grad, m, v, t, *, lr, wd, adam,
+                    b1=0.9, b2=0.999, eps=1e-8):
+    """Shared ES parameter update (ascent direction): plain SGD or
+    bias-corrected Adam on the estimated gradient, with decoupled
+    (AdamW-style) weight decay applied to params directly, never routed
+    through the adaptive moments. The ONE copy of this math — used by
+    both the SPMD device step and :class:`AskTellES`, so the two paths
+    cannot drift. Returns ``(new_params, m, v, t)``; in sgd mode the
+    moment slots pass through untouched (zero-size placeholders)."""
+    import jax.numpy as jnp
+
+    if adam:
+        t = t + 1.0
+        m = b1 * m + (1 - b1) * grad
+        v = b2 * v + (1 - b2) * grad * grad
+        m_hat = m / (1 - b1 ** t)
+        v_hat = v / (1 - b2 ** t)
+        update = lr * m_hat / (jnp.sqrt(v_hat) + eps)
+    else:
+        update = lr * grad
+    return params + update - lr * wd * params, m, v, t
+
+
 def centered_rank(x):
     """Map fitness to centered ranks in [-0.5, 0.5] (OpenAI-ES shaping)."""
     import jax.numpy as jnp
@@ -89,21 +112,18 @@ class EvolutionStrategy:
         self.pairs_per_dev = self.pop_size // quantum
         self._fused_cache: dict = {}
         # Pallas fused-noise path: regenerate eps instead of storing it
-        # (fiber_tpu/ops/pallas_es.py). "auto" engages it only on TPU,
-        # only after the noise-quality self-check passes, AND only if a
-        # timed race at THIS instance's (pairs, dim) says the fused
-        # path beats plain jnp — correctness alone must not gate in a
-        # kernel whose sequential grid can lose to XLA's fused RNG.
+        # (fiber_tpu/ops/pallas_es.py). "auto" resolves to OFF: the
+        # fused-program A/B on the chip (bench.py --ab-pallas, recorded
+        # in RUNS/bench_tpu_success.json) measured the pallas path ~30x
+        # slower end-to-end at bench shapes — the custom-call grids
+        # serialize inside the rollout scan while XLA fuses the
+        # threefry noise into it, and HBM traffic was never the
+        # bottleneck here. An isolated kernel race mispredicts that
+        # (dispatch overhead dominates), so the default is simply the
+        # measured winner; pass use_pallas=True to force the kernels
+        # (they remain correctness-validated on hardware).
         if use_pallas == "auto":
-            from fiber_tpu.ops.pallas_es import (
-                pallas_available,
-                pallas_wins,
-            )
-
-            self.use_pallas = (
-                pallas_available()
-                and pallas_wins(self.pairs_per_dev, dim, self.sigma)
-            )
+            self.use_pallas = False
         else:
             self.use_pallas = bool(use_pallas)
         # NOTE: pairs_per_dev is NOT rounded up to the pallas
@@ -141,7 +161,6 @@ class EvolutionStrategy:
             wsum_fn = build_weighted_eps_sum(pairs, dim)
 
         adam = self.optimizer == "adam"
-        b1, b2, eps_adam = 0.9, 0.999, 1e-8
 
         def device_step(params, m, v, t, key):
             # params (dim,) replicated; key replicated. In sgd mode the
@@ -181,21 +200,12 @@ class EvolutionStrategy:
             else:
                 g_local = w @ eps                      # (dim,) on the MXU
             grad = jax.lax.psum(g_local, "pool") / (pop * sigma)
-            if adam:
-                # Ascent-direction Adam (OpenAI-ES uses Adam on the
-                # estimated gradient); state is replicated like params.
-                t_new = t + 1.0
-                m_new = b1 * m + (1 - b1) * grad
-                v_new = b2 * v + (1 - b2) * grad * grad
-                m_hat = m_new / (1 - b1 ** t_new)
-                v_hat = v_new / (1 - b2 ** t_new)
-                update = lr * m_hat / (jnp.sqrt(v_hat) + eps_adam)
-            else:
-                t_new, m_new, v_new = t, m, v
-                update = lr * grad
-            # Decoupled weight decay: applied to params directly, never
-            # routed through the adaptive moments (AdamW-style).
-            new_params = params + update - lr * wd * params
+            # Optimizer state is replicated like params; the update
+            # math is the shared apply_es_update (one copy, also used
+            # by AskTellES).
+            new_params, m_new, v_new, t_new = apply_es_update(
+                params, grad, m, v, t, lr=lr, wd=wd, adam=adam,
+            )
             stats = jnp.stack([
                 flat_fit.mean(),
                 flat_fit.max(),
@@ -317,3 +327,113 @@ class EvolutionStrategy:
                 host = jax.device_get(stats)
                 history.append((gen, float(host[0]), float(host[1])))
         return params, history
+
+
+class AskTellES:
+    """OpenAI-ES behind an ask/tell interface — for eval functions that
+    are NOT jittable (external simulators, subprocess rollouts, gym
+    envs). This is the reference's actual user workflow: its gecco-2020
+    example samples perturbations centrally and farms evaluation
+    through ``fiber.Pool(40).map`` of arbitrary Python
+    (/root/reference/examples/gecco-2020/es.py); here the same loop is
+
+        es = AskTellES(dim, pop_size)
+        thetas = es.ask(key)                  # (pop, dim) numpy
+        fits = pool.map(simulate, thetas)     # any Python you like
+        es.tell(fits)                         # rank-shape + update
+
+    Sampling and the update run as jitted device programs (antithetic
+    gaussian pairs, centered-rank shaping, SGD or Adam — identical math
+    to :class:`EvolutionStrategy`); only the candidate matrix crosses
+    the host boundary, because the evaluator lives there by definition.
+    For jittable eval_fns use :class:`EvolutionStrategy` — the whole
+    generation stays on the mesh.
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        pop_size: int,
+        sigma: float = 0.1,
+        lr: float = 0.02,
+        weight_decay: float = 0.0,
+        optimizer: str = "sgd",
+        params0=None,
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if optimizer not in ("sgd", "adam"):
+            raise ValueError(f"unknown optimizer {optimizer!r}")
+        if pop_size < 2:
+            raise ValueError("pop_size must be >= 2")
+        self.dim = int(dim)
+        self.pairs = max(1, pop_size // 2)
+        self.pop_size = 2 * self.pairs
+        self.sigma = float(sigma)
+        self.lr = float(lr)
+        self.weight_decay = float(weight_decay)
+        self.optimizer = optimizer
+        self.params = (jnp.zeros((dim,), jnp.float32) if params0 is None
+                       else jnp.asarray(params0, jnp.float32))
+        if self.params.shape != (self.dim,):
+            raise ValueError(
+                f"params0 shape {self.params.shape} != ({dim},)")
+        zeros = jnp.zeros_like(self.params)
+        self._m, self._v, self._t = zeros, zeros, jnp.asarray(0.0)
+        self._eps = None  # set by ask(), consumed by tell()
+
+        sigma_c, lr_c, wd = self.sigma, self.lr, self.weight_decay
+        pairs, pop = self.pairs, self.pop_size
+        adam = optimizer == "adam"
+
+        @jax.jit
+        def sample(params, key):
+            eps = jax.random.normal(key, (pairs, dim))
+            thetas = jnp.concatenate(
+                [params + sigma_c * eps, params - sigma_c * eps], axis=0
+            )
+            return thetas, eps
+
+        @jax.jit
+        def update(params, eps, fitness, m, v, t):
+            ranks = centered_rank(fitness)
+            w = ranks[:pairs] - ranks[pairs:]
+            grad = (w @ eps) / (pop * sigma_c)
+            return apply_es_update(
+                params, grad, m, v, t, lr=lr_c, wd=wd, adam=adam,
+            )
+
+        self._sample = sample
+        self._update = update
+
+    def ask(self, key):
+        """Draw the next antithetic population: (pop_size, dim) numpy
+        array, rows [plus-half; minus-half]."""
+        import jax
+        import numpy as np
+
+        if self._eps is not None:
+            raise RuntimeError("ask() called twice without tell()")
+        thetas, eps = self._sample(self.params, key)
+        self._eps = eps
+        return np.asarray(jax.device_get(thetas))
+
+    def tell(self, fitnesses) -> dict:
+        """Report fitnesses (len pop_size, ask()'s row order; higher is
+        better) and apply the update. Returns summary stats."""
+        import jax.numpy as jnp
+
+        if self._eps is None:
+            raise RuntimeError("tell() called before ask()")
+        fits = jnp.asarray(fitnesses, jnp.float32).reshape(-1)
+        if fits.shape[0] != self.pop_size:
+            raise ValueError(
+                f"need {self.pop_size} fitnesses, got {fits.shape[0]}")
+        self.params, self._m, self._v, self._t = self._update(
+            self.params, self._eps, fits, self._m, self._v, self._t)
+        self._eps = None
+        return {
+            "mean_fitness": float(fits.mean()),
+            "max_fitness": float(fits.max()),
+        }

@@ -19,9 +19,18 @@ Net effect per generation: HBM traffic drops from ~3·pop·dim floats
 (write eps, read eps twice) to ~2·pop·dim (write thetas, read nothing) —
 and the RNG FLOPs are free next to the MXU work.
 
-Both kernels run in Pallas interpret mode on CPU for testing; the
-EvolutionStrategy engages them automatically on TPU via
-``use_pallas="auto"``.
+Both kernels run in Pallas interpret mode on CPU for testing and are
+correctness-validated on hardware (noise quality, antithetic symmetry,
+and perturb/gradient regeneration agreement to ~1e-5 at bench shapes).
+
+**Measured outcome (recorded in RUNS/bench_tpu_success.json):** at the
+flagship workload's shapes the fused path LOSES to plain jnp by ~30x
+end-to-end — the custom-call grids serialize inside the rollout scan
+while XLA fuses its threefry noise into it, and HBM was not the
+bottleneck. ``use_pallas="auto"`` therefore resolves to the jnp path;
+pass ``use_pallas=True`` to force these kernels (regimes where the
+trade could flip: much larger dim·pop per device, HBM-bound eval_fns).
+``bench.py --ab-pallas`` records both paths' throughput on hardware.
 """
 
 from __future__ import annotations
@@ -259,82 +268,6 @@ def build_weighted_eps_sum(pairs: int, dim: int,
 
 
 _SELF_CHECK: Optional[bool] = None
-_RACE_CACHE: dict = {}
-
-
-def pallas_wins(pairs: int, dim: int, sigma: float = 0.1,
-                trials: int = 3) -> bool:
-    """Timed race at the caller's REAL shapes: the fused pallas
-    noise+gradient path vs the plain jnp path (threefry normal + MXU
-    matmul). Returns True iff pallas is faster; cached per shape.
-
-    Rationale: the pallas kernels trade HBM traffic for recompute, but
-    their grids execute sequentially on the TensorCore — whether that
-    trade wins depends on (pairs, dim) and the surrounding program, so
-    the self-check (correctness) alone must not gate them IN. A
-    measured race is the only honest default; the decision and both
-    timings are logged.
-    """
-    key = (pairs, dim)
-    cached = _RACE_CACHE.get(key)
-    if cached is not None:
-        return cached
-    import time
-
-    import jax
-    import jax.numpy as jnp
-
-    from fiber_tpu.utils.logging import get_logger
-
-    try:
-        perturb = build_perturb(pairs, dim, sigma)
-        wsum = build_weighted_eps_sum(pairs, dim)
-        params = jnp.zeros((dim,), jnp.float32)
-        seed = jnp.asarray([3, 11], jnp.int32)
-        w = jnp.linspace(-1.0, 1.0, pairs)
-
-        # ONE jitted program like production (device_step embeds both
-        # kernels in a single compile) — two separate dispatches would
-        # double-charge pallas the dispatch overhead at small shapes.
-        @jax.jit
-        def pallas_path():
-            th = perturb(params, seed)
-            return th, wsum(w, seed)
-
-        @jax.jit
-        def jnp_path(rng):
-            eps = jax.random.normal(rng, (pairs, dim))
-            th = jnp.concatenate(
-                [params + sigma * eps, params - sigma * eps], axis=0)
-            return th, w @ eps
-
-        rng = jax.random.PRNGKey(0)
-
-        def clock(fn, *args):
-            jax.block_until_ready(fn(*args))  # compile + warm
-            best = float("inf")
-            for _ in range(trials):
-                t0 = time.perf_counter()
-                jax.block_until_ready(fn(*args))
-                best = min(best, time.perf_counter() - t0)
-            return best
-
-        t_pallas = clock(pallas_path)
-        t_jnp = clock(jnp_path, rng)
-        wins = t_pallas < t_jnp
-        get_logger().info(
-            "pallas race (pairs=%d, dim=%d): pallas %.4fs vs jnp %.4fs"
-            " -> %s", pairs, dim, t_pallas, t_jnp,
-            "pallas" if wins else "jnp",
-        )
-    except Exception:  # noqa: BLE001 - any failure means "don't use it"
-        get_logger().info(
-            "pallas race failed (pairs=%d, dim=%d); using jnp path",
-            pairs, dim, exc_info=True,
-        )
-        wins = False
-    _RACE_CACHE[key] = wins
-    return wins
 
 
 def pallas_available() -> bool:

@@ -46,3 +46,11 @@ def test_novelty_maze_example():
     assert "plain ES" in out
     assert "NSRA-ES" in out
     assert "novelty search done" in out
+
+
+def test_es_pool_gym_example():
+    """Ask/tell ES + Pool evaluating a pure-Python simulator (the
+    reference's gecco-2020 workflow shape)."""
+    out = _run("es_pool_gym.py", "--workers", "2", "--pop", "16",
+               "--gens", "2", timeout=480)
+    assert "pool-evaluated ES done" in out

@@ -271,10 +271,8 @@ def main() -> int:
 
     # Warmup compiles AND executes the fused N-generation program once
     # (the timed section re-runs the same program, measuring steady
-    # state). The watchdog arms BEFORE the EvolutionStrategy is built:
-    # use_pallas="auto" runs a timed kernel race at real shapes inside
-    # __init__, and a wedged race compile must still produce the JSON
-    # line.
+    # state). The watchdog stays armed until the warmup completes — a
+    # wedged compile must still produce a JSON line.
     compile_watchdog = _watchdog(
         args.init_timeout,
         {**fail_payload, "error": "compile/warmup timed out"},
@@ -330,7 +328,12 @@ def main() -> int:
 
     # The sections below are additive: a failure in any of them must not
     # discard the ES number already measured — the one-JSON-line contract
-    # holds no matter what (errors ride along in the line instead).
+    # holds no matter what (errors ride along in the line instead). The
+    # headline number is RECORDED durably right now, before the extras:
+    # if an extra leg wedges and its watchdog hard-exits, the record
+    # file already carries the measurement (the final record call below
+    # just enriches it).
+    _record_or_attach_tpu_run(result, wedged=args.wedged_fallback)
     if args.ab_pallas:
         # Same workload on the OTHER noise path (auto resolves to the
         # measured winner for the primary run; the A/B forces the other

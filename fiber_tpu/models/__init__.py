@@ -6,6 +6,10 @@ from fiber_tpu.models.policies import (  # noqa: F401
     GRUPolicy,
     MLPPolicy,
 )
+from fiber_tpu.models.transformer import (  # noqa: F401
+    TinyLM,
+    make_train_step,
+)
 from fiber_tpu.models.envs import (  # noqa: F401
     CartPole,
     DeceptiveMaze,

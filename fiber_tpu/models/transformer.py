@@ -1,0 +1,176 @@
+"""Tiny transformer LM over the sequence-parallel attention planes.
+
+The reference framework has no model-training story at all (it is a
+task-parallel library); this module is the beyond-parity demonstration
+that fiber_tpu's long-context planes — ring attention
+(:func:`fiber_tpu.ops.ring_attention`) and Ulysses
+(:func:`fiber_tpu.ops.ulysses_attention`) — are not inference toys: a
+causal LM trains through them with jax AD (their gradients match
+full-matrix attention; tests/test_device.py pins that), with the
+sequence axis sharded over the mesh so context length scales with
+device count.
+
+Deliberately small and dependency-free (pure jnp pytree params, no
+flax): the framework's flagship workloads are population-based, and
+this exists to prove the sequence-parallel plane end to end —
+embedding -> [RMSNorm -> attention -> residual -> RMSNorm -> MLP ->
+residual] x L -> norm -> logits.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class TinyLM:
+    """Causal byte/token LM. ``attention`` picks the plane:
+    ``"ring"`` (sequence sharded via ppermute ring + online softmax),
+    ``"ulysses"`` (all-to-all head/seq swap; needs
+    ``heads % n_devices == 0``), or ``"reference"`` (full score matrix,
+    single device — for parity tests).
+
+    ``apply(params, tokens (S,)) -> (S, vocab)`` logits;
+    ``loss(params, tokens)`` is mean next-token cross-entropy.
+    ``S`` must equal ``max_seq`` (static shapes; pad shorter text).
+    """
+
+    def __init__(
+        self,
+        vocab: int = 256,
+        dim: int = 64,
+        heads: int = 8,
+        layers: int = 2,
+        max_seq: int = 256,
+        mlp_mult: int = 4,
+        mesh=None,
+        attention: str = "ring",
+    ) -> None:
+        if dim % heads:
+            raise ValueError(f"dim {dim} not divisible by heads {heads}")
+        if attention not in ("ring", "ulysses", "reference"):
+            raise ValueError(f"unknown attention {attention!r}")
+        self.vocab = vocab
+        self.dim = dim
+        self.heads = heads
+        self.head_dim = dim // heads
+        self.layers = layers
+        self.max_seq = max_seq
+        self.mlp_mult = mlp_mult
+        self.attention = attention
+        self._mesh = mesh
+
+    # ------------------------------------------------------------------
+    def init(self, key) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        k_emb, k_pos, k_out, key = jax.random.split(key, 4)
+        scale = 0.02
+        params = {
+            "embed": scale * jax.random.normal(
+                k_emb, (self.vocab, self.dim)),
+            "pos": scale * jax.random.normal(
+                k_pos, (self.max_seq, self.dim)),
+            "out": scale * jax.random.normal(
+                k_out, (self.dim, self.vocab)),
+            "final_norm": jnp.ones((self.dim,)),
+            "blocks": [],
+        }
+        for _ in range(self.layers):
+            keys = jax.random.split(key, 7)
+            key = keys[6]
+            d, h = self.dim, self.mlp_mult * self.dim
+            params["blocks"].append({
+                "norm1": jnp.ones((d,)),
+                "wqkv": scale * jax.random.normal(keys[0], (d, 3 * d)),
+                "wo": scale * jax.random.normal(keys[1], (d, d)),
+                "norm2": jnp.ones((d,)),
+                "w1": scale * jax.random.normal(keys[2], (d, h)),
+                "b1": jnp.zeros((h,)),
+                "w2": scale * jax.random.normal(keys[3], (h, d)),
+                "b2": jnp.zeros((d,)),
+            })
+        return params
+
+    # ------------------------------------------------------------------
+    def _attend(self, q, k, v):
+        if self.attention == "reference":
+            from fiber_tpu.ops.ring_attention import reference_attention
+
+            return reference_attention(q, k, v, causal=True)
+        if self.attention == "ulysses":
+            from fiber_tpu.ops.ulysses_attention import ulysses_attention
+
+            return ulysses_attention(q, k, v, mesh=self._mesh,
+                                     causal=True)
+        from fiber_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, mesh=self._mesh, causal=True)
+
+    @staticmethod
+    def _rms(x, g):
+        import jax.numpy as jnp
+
+        return g * x / jnp.sqrt(
+            jnp.mean(x * x, axis=-1, keepdims=True) + 1e-6)
+
+    def apply(self, params, tokens):
+        """tokens (max_seq,) int -> logits (max_seq, vocab)."""
+        import jax
+        import jax.numpy as jnp
+
+        S, H, Dh = self.max_seq, self.heads, self.head_dim
+        x = params["embed"][tokens] + params["pos"]          # (S, dim)
+        for blk in params["blocks"]:
+            h = self._rms(x, blk["norm1"])
+            qkv = h @ blk["wqkv"]                            # (S, 3*dim)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(S, H, Dh)
+            k = k.reshape(S, H, Dh)
+            v = v.reshape(S, H, Dh)
+            attn = self._attend(q, k, v).reshape(S, -1)
+            x = x + attn @ blk["wo"]
+            h = self._rms(x, blk["norm2"])
+            x = x + jax.nn.gelu(h @ blk["w1"] + blk["b1"]) @ blk["w2"] \
+                + blk["b2"]
+        x = self._rms(x, params["final_norm"])
+        return x @ params["out"]
+
+    def loss(self, params, tokens):
+        """Mean next-token cross-entropy over positions 0..S-2."""
+        import jax
+        import jax.numpy as jnp
+
+        logits = self.apply(params, tokens)[:-1]             # (S-1, V)
+        targets = tokens[1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(
+            jnp.take_along_axis(logp, targets[:, None], axis=1))
+
+
+def make_train_step(model: TinyLM, optimizer, batched: bool = False):
+    """(params, opt_state, tokens) -> (params, opt_state, loss), jitted.
+    ``optimizer`` is any optax-style (init, update) pair. With
+    ``batched=True`` tokens is (B, max_seq) and the loss is the batch
+    mean — the batch axis vmaps straight over the sequence-sharded
+    attention (each sequence still spans the mesh)."""
+    import jax
+
+    if batched:
+        def loss_fn(params, tokens):
+            import jax.numpy as jnp
+
+            return jnp.mean(
+                jax.vmap(lambda t: model.loss(params, t))(tokens))
+    else:
+        loss_fn = model.loss
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        import optax
+
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step)

@@ -379,7 +379,10 @@ class AskTellES:
         if self.params.shape != (self.dim,):
             raise ValueError(
                 f"params0 shape {self.params.shape} != ({dim},)")
-        zeros = jnp.zeros_like(self.params)
+        # Same convention as EvolutionStrategy: sgd carries zero-size
+        # moment placeholders so no dead (dim,) state rides the update.
+        zeros = (jnp.zeros_like(self.params) if optimizer == "adam"
+                 else jnp.zeros((0,), jnp.float32))
         self._m, self._v, self._t = zeros, zeros, jnp.asarray(0.0)
         self._eps = None  # set by ask(), consumed by tell()
 

@@ -1093,3 +1093,119 @@ def test_ask_tell_es_contract_and_training():
     final = float(np_.sum(
         (np.asarray(jax.device_get(es.params)) - target) ** 2))
     assert final < 0.05, final
+
+
+def test_sharded_attention_gradients_match_reference():
+    """Both sequence-parallel attention planes must be differentiable
+    through jax AD with gradients matching full-matrix attention — the
+    property that makes them usable for TRAINING, not just inference
+    (the ppermute ring and the all-to-alls are linear ops; the online
+    softmax rematerializes cleanly)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from fiber_tpu.ops.ring_attention import (
+        reference_attention,
+        ring_attention,
+    )
+    from fiber_tpu.ops.ulysses_attention import ulysses_attention
+
+    mesh = Mesh(np.asarray(jax.devices()), ("pool",))
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    S, H, D = 64, 8, 8
+    q = jax.random.normal(kq, (S, H, D))
+    k = jax.random.normal(kk, (S, H, D))
+    v = jax.random.normal(kv, (S, H, D))
+
+    def loss(attn):
+        return lambda q, k, v: jnp.sum(attn(q, k, v) ** 2)
+
+    g_ref = jax.grad(loss(
+        lambda q, k, v: reference_attention(q, k, v, causal=True)),
+        argnums=(0, 1, 2))(q, k, v)
+    for attn_name, attn in [
+        ("ring", lambda q, k, v: ring_attention(
+            q, k, v, mesh=mesh, causal=True)),
+        ("ulysses", lambda q, k, v: ulysses_attention(
+            q, k, v, mesh=mesh, causal=True)),
+    ]:
+        g = jax.grad(loss(attn), argnums=(0, 1, 2))(q, k, v)
+        for got, want, wrt in zip(g, g_ref, "qkv"):
+            err = float(jnp.abs(got - want).max())
+            assert err < 1e-4, (attn_name, wrt, err)
+
+
+def test_tiny_lm_trains_through_sharded_attention():
+    """TinyLM: (a) forward through ring AND ulysses attention matches
+    the reference-attention forward exactly (same params); (b) a
+    training loop through the sequence-sharded plane actually learns
+    (memorizes a fixed sequence to near-zero loss) — the
+    sequence-parallel plane is a TRAINING surface, not inference-only."""
+    import jax
+    import optax
+
+    from fiber_tpu.models import TinyLM, make_train_step
+
+    S = 128
+    ref = TinyLM(vocab=32, dim=64, heads=8, layers=2, max_seq=S,
+                 attention="reference")
+    params = ref.init(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (S,), 0, 32)
+    want = np.asarray(jax.device_get(ref.apply(params, toks)))
+    for plane in ("ring", "ulysses"):
+        model = TinyLM(vocab=32, dim=64, heads=8, layers=2, max_seq=S,
+                       attention=plane)
+        got = np.asarray(jax.device_get(model.apply(params, toks)))
+        assert np.abs(got - want).max() < 1e-5, plane
+
+    model = TinyLM(vocab=32, dim=64, heads=8, layers=2, max_seq=S,
+                   attention="ring")
+    opt = optax.adamw(3e-3)
+    step = make_train_step(model, opt)
+    opt_state = opt.init(params)
+    first = None
+    for _ in range(80):
+        params, opt_state, loss = step(params, opt_state, toks)
+        if first is None:
+            first = float(loss)
+    assert first > 3.0 and float(loss) < 0.1, (first, float(loss))
+
+
+def test_tiny_lm_induction_through_ring_attention():
+    """The induction capability probe: trained on sequences whose
+    second half repeats the first, the model must learn to predict the
+    second half (which requires attending ~S/2 back through the
+    sequence-SHARDED attention) while the first half stays at random —
+    long-range structure actually flows through the ring."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from fiber_tpu.models import TinyLM, make_train_step
+
+    S, V, B = 64, 16, 16
+    model = TinyLM(vocab=V, dim=128, heads=8, layers=2, max_seq=S,
+                   attention="ring")
+    params = model.init(jax.random.PRNGKey(0))
+    opt = optax.adamw(1e-3, weight_decay=0.01)
+    opt_state = opt.init(params)
+    step = make_train_step(model, opt, batched=True)
+    half = S // 2
+
+    key = jax.random.PRNGKey(1)
+    for _ in range(200):
+        key, k = jax.random.split(key)
+        h = jax.random.randint(k, (B, half), 0, V)
+        toks = jnp.concatenate([h, h], axis=1)
+        params, opt_state, _ = step(params, opt_state, toks)
+
+    def one(t):
+        logits = model.apply(params, t)[:-1]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, t[1:][:, None], axis=1)
+        return nll[: half - 1].mean(), nll[half - 1:].mean()
+
+    l1, l2 = jax.vmap(one)(toks)
+    l1, l2 = float(l1.mean()), float(l2.mean())
+    assert l2 < 1.0 < l1, (l1, l2)  # copied half learned, random half not

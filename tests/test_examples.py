@@ -54,3 +54,10 @@ def test_es_pool_gym_example():
     out = _run("es_pool_gym.py", "--workers", "2", "--pop", "16",
                "--gens", "2", timeout=480)
     assert "pool-evaluated ES done" in out
+
+
+def test_long_context_lm_example():
+    """Sequence-sharded LM training demo (smoke config)."""
+    out = _run("long_context_lm.py", "--seq", "64", "--steps", "5",
+               "--batch", "4", "--dim", "32", timeout=480)
+    assert "long-context training done" in out

@@ -147,6 +147,10 @@ def main() -> int:
                              "no attention at all)")
     parser.add_argument("--seq", type=int, default=16384,
                         help="sequence length for --attention")
+    parser.add_argument("--lm", action="store_true",
+                        help="bench long-context TRAINING instead: TinyLM "
+                             "optimizer steps (fwd+bwd+adamw) with the "
+                             "sequence ring-sharded at --seq tokens")
     parser.add_argument("--ab-pallas", action="store_true",
                         help="also time the ES with use_pallas forced off "
                              "and report both (TPU A/B)")
@@ -155,26 +159,28 @@ def main() -> int:
     args = parser.parse_args()
     if args.gens < 1:
         parser.error("--gens must be >= 1")
-    if sum((args.poet, args.pixels, args.biped, args.attention)) > 1:
-        parser.error("--poet/--pixels/--biped/--attention are mutually "
-                     "exclusive")
+    if sum((args.poet, args.pixels, args.biped, args.attention,
+            args.lm)) > 1:
+        parser.error("--poet/--pixels/--biped/--attention/--lm are "
+                     "mutually exclusive")
     if args.pop is not None and args.pop < 2:
         parser.error("--pop must be >= 2")
     if args.steps is not None and args.steps < 1:
         parser.error("--steps must be >= 1")
-    if args.attention and args.seq < 64:
+    if (args.attention or args.lm) and args.seq < 64:
         parser.error("--seq must be >= 64")
 
     metric = ("poet_policy_evals_per_sec" if args.poet
               else "es_pixel_evals_per_sec" if args.pixels
               else "es_biped_evals_per_sec" if args.biped
               else "ring_attention_tokens_per_sec" if args.attention
+              else "lm_train_tokens_per_sec" if args.lm
               else "es_policy_evals_per_sec")
     fail_payload = {
         "metric": metric,
         "value": 0.0,
-        "unit": "tokens/s" if args.attention else "evals/s",
-        "vs_baseline": None if args.attention else 0.0,
+        "unit": "tokens/s" if (args.attention or args.lm) else "evals/s",
+        "vs_baseline": None if (args.attention or args.lm) else 0.0,
         "error": "accelerator backend initialization timed out",
     }
 
@@ -201,7 +207,7 @@ def main() -> int:
             args.pop = 4096
         if args.steps is None:
             args.steps = 400 if args.biped else 500
-    elif not (args.pixels or args.attention):
+    elif not (args.pixels or args.attention or args.lm):
         tuned = _tuned_config(devices[0].platform)
         if args.pop is None:
             args.pop = tuned.get("pop") or 4096
@@ -219,6 +225,8 @@ def main() -> int:
         return _poet_bench(args, devices)
     if args.attention:
         return _attention_bench(args, devices)
+    if args.lm:
+        return _lm_bench(args, devices)
 
     import numpy as np
     from jax.sharding import Mesh
@@ -531,6 +539,68 @@ def _attention_bench(args, devices) -> int:
         "attn_flops_per_sec": round(
             # causal exact attention: ~2 * 2 * seq^2/2 * heads * hd
             2.0 * seq * seq * heads * head_dim * iters / elapsed, 1),
+    }
+    _record_or_attach_tpu_run(result, wedged=args.wedged_fallback)
+    _emit(result)
+    return 0
+
+
+def _lm_bench(args, devices) -> int:
+    """Long-context TRAINING throughput: optimizer steps of TinyLM with
+    the sequence sharded over the mesh via ring attention (forward +
+    backward + adamw). Beyond-parity metric — the reference trains
+    nothing — so vs_baseline is null."""
+    import numpy as np
+
+    import jax
+    import optax
+    from jax.sharding import Mesh
+
+    from fiber_tpu.models import TinyLM, make_train_step
+
+    n_dev = len(devices)
+    mesh = Mesh(np.asarray(devices), ("pool",))
+    seq = max(args.seq - args.seq % max(n_dev, 1), n_dev)
+    dim, heads, layers, vocab = 256, 8, 4, 256
+    # Watchdog arms BEFORE any device work: model/optimizer init and
+    # the token draw are eager device ops that can wedge on a flaky
+    # accelerator just like the compile can.
+    watchdog = _watchdog(args.init_timeout, {
+        "metric": "lm_train_tokens_per_sec", "value": 0.0,
+        "unit": "tokens/s", "vs_baseline": None,
+        "error": "lm compile/warmup timed out",
+    })
+    model = TinyLM(vocab=vocab, dim=dim, heads=heads, layers=layers,
+                   max_seq=seq, mesh=mesh, attention="ring")
+    params = model.init(jax.random.PRNGKey(0))
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+    step = make_train_step(model, opt)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (seq,), 0, vocab)
+    params, opt_state, loss = step(params, opt_state, toks)
+    jax.block_until_ready(loss)
+    watchdog.cancel()
+
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, toks)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+
+    result = {
+        "metric": "lm_train_tokens_per_sec",
+        "value": round(seq * iters / elapsed, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "seq_len": seq,
+        "dim": dim,
+        "heads": heads,
+        "layers": layers,
+        "attention": "ring",
+        "n_devices": n_dev,
+        "platform": devices[0].platform,
+        "final_loss": float(jax.device_get(loss)),
     }
     _record_or_attach_tpu_run(result, wedged=args.wedged_fallback)
     _emit(result)

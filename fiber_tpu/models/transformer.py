@@ -168,9 +168,11 @@ def make_train_step(model: TinyLM, optimizer, batched: bool = False):
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        import optax
-
-        params = optax.apply_updates(params, updates)
+        # Plain tree-map instead of optax.apply_updates: the optimizer
+        # only needs the (init, update) protocol — no hard optax
+        # dependency in the library (it isn't in install_requires).
+        params = jax.tree_util.tree_map(
+            lambda p, u: p + u, params, updates)
         return params, opt_state, loss
 
     return jax.jit(step)

@@ -113,6 +113,9 @@ def harvest() -> None:
         ("attention bench",
          [sys.executable, "bench.py", "--attention", "--seq", "32768"],
          1500, None),
+        ("lm train bench",
+         [sys.executable, "bench.py", "--lm", "--seq", "8192"],
+         1500, None),
     ]
     for name, cmd, timeout, env in steps:
         if cmd is None:

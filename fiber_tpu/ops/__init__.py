@@ -20,6 +20,10 @@ from fiber_tpu.ops.novelty import (  # noqa: F401
     NoveltyState,
     knn_novelty,
 )
+from fiber_tpu.ops.map_elites import (  # noqa: F401
+    MAPElites,
+    MapElitesState,
+)
 from fiber_tpu.ops.poet import POET  # noqa: F401
 from fiber_tpu.ops.ring_attention import ring_attention  # noqa: F401
 from fiber_tpu.ops.ulysses_attention import ulysses_attention  # noqa: F401

@@ -1,0 +1,249 @@
+"""MAP-Elites (quality-diversity) — TPU-native.
+
+Completes the quality-diversity pair next to the NS-ES family
+(:mod:`fiber_tpu.ops.novelty`): where novelty search follows a gradient
+*away* from visited behaviors, MAP-Elites (Mouret & Clune 2015,
+"Illuminating search spaces by mapping elites") discretizes behavior
+space into a grid and keeps the best solution ("elite") ever found in
+each cell — returning an illuminated map of what's possible, not one
+solution. It's the algorithm family the reference's user base (POET /
+open-ended search) reaches for alongside ES.
+
+TPU-first design — the whole algorithm is dense tensor state and one
+jitted SPMD step per generation:
+
+* the archive is ``(cells, dim)`` genomes + ``(cells,)`` fitness
+  (empty cells carry ``-inf``), replicated on the mesh — no host dict;
+* parent selection is a masked uniform draw over filled cells
+  (replicated RNG, identical on every device);
+* children are perturbed and evaluated sharded over the mesh's
+  ``pool`` axis (the population axis, like every ES here);
+* insertion handles batch collisions AND incumbents in one pass: the
+  candidates (children + incumbents) go through a ``segment_max`` per
+  cell, then the winning candidate's payload is GATHERED per cell —
+  conflict-free by construction (XLA scatter-set with duplicate
+  indices has unspecified order, so a sorted scatter would be wrong);
+* stats are QD-score (sum of elite fitness), coverage, best fitness.
+
+``eval_fn(theta, key) -> (fitness, behavior)`` — the same contract as
+:class:`fiber_tpu.ops.NoveltyES`. Behavior is binned by ``bc_low`` /
+``bc_high`` / ``cells_per_dim``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Tuple
+
+
+class MapElitesState(NamedTuple):
+    """Device-resident archive (a pytree — checkpointable as-is)."""
+
+    genomes: object      # (cells, dim)
+    fitness: object      # (cells,) — -inf marks an empty cell
+    behaviors: object    # (cells, bc_dim) — elite behavior per cell
+
+
+class MAPElites:
+    """Grid-archive quality-diversity search on the SPMD mesh.
+
+    ``cells_per_dim`` may be an int (same for every BC dim) or a tuple;
+    the total cell count is their product. ``batch_size`` children are
+    generated per ``step`` (rounded to the mesh quantum).
+    """
+
+    def __init__(
+        self,
+        eval_fn: Callable,
+        dim: int,
+        bc_dim: int,
+        bc_low,
+        bc_high,
+        cells_per_dim=16,
+        batch_size: int = 256,
+        sigma: float = 0.1,
+        mesh=None,
+    ) -> None:
+        import numpy as np
+
+        from fiber_tpu.parallel.mesh import default_mesh
+
+        self.eval_fn = eval_fn
+        self.dim = int(dim)
+        self.bc_dim = int(bc_dim)
+        self.bc_low = np.asarray(bc_low, np.float32).reshape(bc_dim)
+        self.bc_high = np.asarray(bc_high, np.float32).reshape(bc_dim)
+        if np.any(self.bc_high <= self.bc_low):
+            raise ValueError("bc_high must exceed bc_low per dim")
+        if isinstance(cells_per_dim, int):
+            cells_per_dim = (cells_per_dim,) * bc_dim
+        if len(cells_per_dim) != bc_dim:
+            raise ValueError(
+                f"cells_per_dim {cells_per_dim} != bc_dim {bc_dim}")
+        self.cells_per_dim = tuple(int(c) for c in cells_per_dim)
+        self.n_cells = int(np.prod(self.cells_per_dim))
+        self.sigma = float(sigma)
+        self.mesh = mesh or default_mesh()
+        self.n_dev = int(np.prod(list(self.mesh.shape.values())))
+        self.batch_size = max(self.n_dev,
+                              (batch_size // self.n_dev) * self.n_dev)
+        self.per_dev = self.batch_size // self.n_dev
+        self._step = self._build_step()
+
+    # ------------------------------------------------------------------
+    def init_state(self, params0, key) -> MapElitesState:
+        """Archive seeded with the starting genome's cell."""
+        import jax
+        import jax.numpy as jnp
+
+        params0 = jnp.asarray(params0)
+        if params0.shape != (self.dim,):
+            raise ValueError(
+                f"params0 shape {params0.shape} != ({self.dim},)")
+        fit0, bc0 = jax.jit(self.eval_fn)(params0, key)
+        genomes = jnp.zeros((self.n_cells, self.dim), jnp.float32)
+        fitness = jnp.full((self.n_cells,), -jnp.inf, jnp.float32)
+        behaviors = jnp.zeros((self.n_cells, self.bc_dim), jnp.float32)
+        cell = self._cell_of(bc0)
+        return MapElitesState(
+            genomes=genomes.at[cell].set(params0.astype(jnp.float32)),
+            fitness=fitness.at[cell].set(fit0),
+            behaviors=behaviors.at[cell].set(bc0.astype(jnp.float32)),
+        )
+
+    def _cell_of(self, bc):
+        """Flat cell index of one behavior vector (jittable)."""
+        import jax.numpy as jnp
+
+        low = jnp.asarray(self.bc_low)
+        high = jnp.asarray(self.bc_high)
+        cpd = jnp.asarray(self.cells_per_dim)
+        frac = (bc - low) / (high - low)
+        idx = jnp.clip((frac * cpd).astype(jnp.int32), 0, cpd - 1)
+        flat = jnp.asarray(0, jnp.int32)
+        for d in range(self.bc_dim):
+            flat = flat * self.cells_per_dim[d] + idx[d]
+        return flat
+
+    # ------------------------------------------------------------------
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        eval_fn = self.eval_fn
+        per_dev = self.per_dev
+        batch = self.batch_size
+        dim = self.dim
+        sigma = self.sigma
+        n_cells = self.n_cells
+        cell_of = self._cell_of
+
+        def device_step(genomes, fitness, behaviors, key):
+            my = jax.lax.axis_index("pool")
+            # Parent selection: uniform over FILLED cells, computed
+            # identically everywhere (replicated key), then each device
+            # takes its slice of the parent batch.
+            filled = fitness > -jnp.inf
+            p = filled.astype(jnp.float32)
+            p = p / jnp.maximum(p.sum(), 1.0)
+            sel_key, rest = jax.random.split(key)
+            parent_cells = jax.random.choice(
+                sel_key, n_cells, (batch,), p=p)          # replicated
+            dev_key = jax.random.fold_in(rest, my)
+            eps_key, eval_key = jax.random.split(dev_key)
+            my_cells = jax.lax.dynamic_slice_in_dim(
+                parent_cells, my * per_dev, per_dev)
+            parents = genomes[my_cells]                   # (per_dev, dim)
+            children = parents + sigma * jax.random.normal(
+                eps_key, (per_dev, dim))
+            eval_keys = jax.random.split(eval_key, per_dev)
+            child_fit, child_bc = jax.vmap(eval_fn)(children, eval_keys)
+
+            # Gather the full generation (everyone needs every child to
+            # keep the replicated archive identical).
+            all_children = jax.lax.all_gather(
+                children, "pool").reshape(batch, dim)
+            all_fit = jax.lax.all_gather(child_fit, "pool").reshape(-1)
+            all_bc = jax.lax.all_gather(
+                child_bc, "pool").reshape(batch, -1)
+            child_cells = jax.vmap(cell_of)(all_bc)
+
+            # Segment-max insertion with payload: candidates = children
+            # + incumbents; per-cell best fitness via segment_max, then
+            # the winning candidate's index per cell (ties break to any
+            # winner), then conflict-free GATHERS for the payloads.
+            # (A sorted scatter would be wrong: XLA scatter-set with
+            # duplicate indices has unspecified application order.)
+            # Incumbents guarantee every cell has >=1 candidate; empty
+            # cells' -inf incumbents lose to any real child.
+            cand_fit = jnp.concatenate([all_fit, fitness])
+            # NaN fitness (divergent rollouts) must lose, not poison:
+            # segment_max propagates NaN, the equality winner-match then
+            # fails for the whole cell, and winner=-1 silently writes
+            # the wrong genome — forever. Demote NaN to -inf up front.
+            cand_fit = jnp.where(jnp.isnan(cand_fit), -jnp.inf, cand_fit)
+            cand_cells = jnp.concatenate(
+                [child_cells, jnp.arange(n_cells, dtype=jnp.int32)])
+            cand_genomes = jnp.concatenate(
+                [all_children.astype(jnp.float32), genomes])
+            cand_bc = jnp.concatenate(
+                [all_bc.astype(jnp.float32), behaviors])
+            seg_best = jax.ops.segment_max(
+                cand_fit, cand_cells, num_segments=n_cells)
+            is_winner = cand_fit == seg_best[cand_cells]
+            n_cand = cand_fit.shape[0]
+            winner = jax.ops.segment_max(
+                jnp.where(is_winner, jnp.arange(n_cand), -1),
+                cand_cells, num_segments=n_cells)
+            new_genomes = cand_genomes[winner]
+            new_fitness = seg_best
+            new_behaviors = cand_bc[winner]
+
+            new_filled = new_fitness > -jnp.inf
+            coverage = new_filled.mean()
+            qd = jnp.where(new_filled, new_fitness, 0.0).sum()
+            stats = jnp.stack([
+                qd, coverage, new_fitness.max(), all_fit.mean(),
+            ])
+            return new_genomes, new_fitness, new_behaviors, stats
+
+        spec = tuple(P() for _ in range(4))
+        stepped = shard_map(
+            device_step,
+            mesh=self.mesh,
+            in_specs=spec,
+            out_specs=spec,
+            check_vma=False,
+        )
+        return jax.jit(stepped)
+
+    # ------------------------------------------------------------------
+    def step(self, state: MapElitesState, key) -> Tuple[MapElitesState,
+                                                        object]:
+        """One generation. stats = [qd_score, coverage, best_fitness,
+        mean_child_fitness]."""
+        genomes, fitness, behaviors, stats = self._step(
+            state.genomes, state.fitness, state.behaviors, key)
+        return MapElitesState(genomes, fitness, behaviors), stats
+
+    def run(self, state: MapElitesState, key, generations: int):
+        """N generations; returns (state, stats_history)."""
+        from fiber_tpu.ops.es import run_steps
+
+        return run_steps(self.step, state, key, generations)
+
+    def elites(self, state: MapElitesState):
+        """Host-side view: list of (cell, fitness, behavior, genome)
+        for filled cells, best first."""
+        import jax
+        import numpy as np
+
+        fit = np.asarray(jax.device_get(state.fitness))
+        genomes = np.asarray(jax.device_get(state.genomes))
+        bcs = np.asarray(jax.device_get(state.behaviors))
+        out = []
+        for c in np.argsort(-fit):
+            if np.isfinite(fit[c]):
+                out.append((int(c), float(fit[c]), bcs[c], genomes[c]))
+        return out

@@ -61,3 +61,11 @@ def test_long_context_lm_example():
     out = _run("long_context_lm.py", "--seq", "64", "--steps", "5",
                "--batch", "4", "--dim", "32", timeout=480)
     assert "long-context training done" in out
+
+
+def test_map_elites_maze_example():
+    """QD illumination demo on the deceptive maze (smoke config)."""
+    out = _run("map_elites_maze.py", "--gens", "3", "--batch", "32",
+               "--cells", "6", timeout=480)
+    assert "coverage" in out
+    assert "map-elites done" in out

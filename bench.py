@@ -154,6 +154,10 @@ def main() -> int:
     parser.add_argument("--ab-pallas", action="store_true",
                         help="also time the ES with use_pallas forced off "
                              "and report both (TPU A/B)")
+    parser.add_argument("--profile", default="",
+                        help="write a jax.profiler trace of the timed ES "
+                             "section to this directory (inspect with "
+                             "tensorboard or xprof)")
     parser.add_argument("--wedged-fallback", action="store_true",
                         help=argparse.SUPPRESS)  # set by the watchdog re-exec
     args = parser.parse_args()
@@ -298,12 +302,19 @@ def main() -> int:
     compile_watchdog.cancel()
 
     # Timed: all generations as ONE fused XLA program (lax.scan over the
-    # step) — no per-generation dispatch overhead.
-    t0 = time.perf_counter()
-    key, k = jax.random.split(key)
-    params, stats_seq = es.run_fused(params, k, args.gens)
-    jax.block_until_ready(stats_seq)
-    elapsed = time.perf_counter() - t0
+    # step) — no per-generation dispatch overhead. --profile wraps this
+    # exact section in a jax.profiler trace.
+    from contextlib import nullcontext
+
+    from fiber_tpu.utils.profiling import trace as profiler_trace
+
+    prof = profiler_trace(args.profile) if args.profile else nullcontext()
+    with prof:
+        t0 = time.perf_counter()
+        key, k = jax.random.split(key)
+        params, stats_seq = es.run_fused(params, k, args.gens)
+        jax.block_until_ready(stats_seq)
+        elapsed = time.perf_counter() - t0
     stats = stats_seq[-1]
 
     total_evals = es.pop_size * args.gens

@@ -203,8 +203,11 @@ class MAPElites:
             new_filled = new_fitness > -jnp.inf
             coverage = new_filled.mean()
             qd = jnp.where(new_filled, new_fitness, 0.0).sum()
+            # nanmean: a single divergent (NaN) rollout must not poison
+            # the generation's mean-child stat (the archive is already
+            # protected by the -inf demotion above).
             stats = jnp.stack([
-                qd, coverage, new_fitness.max(), all_fit.mean(),
+                qd, coverage, new_fitness.max(), jnp.nanmean(all_fit),
             ])
             return new_genomes, new_fitness, new_behaviors, stats
 

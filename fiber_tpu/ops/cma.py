@@ -34,7 +34,10 @@ import math
 from typing import Callable, Tuple
 
 
-class _CMABase:
+from fiber_tpu.ops.es import _FusedRunMixin
+
+
+class _CMABase(_FusedRunMixin):
     """Shared CMA-ES machinery: population quantization over the mesh,
     Hansen's default strategy constants, and the full jitted SPMD
     generation. Subclasses supply the covariance model through four
@@ -200,6 +203,7 @@ class _CMABase:
             return (new_m, new_sigma, new_C, p_sigma, p_c, gen + 1,
                     stats)
 
+        self._device_step_fn = device_step  # reused by run_fused
         stepped = shard_map(
             device_step,
             mesh=self.mesh,

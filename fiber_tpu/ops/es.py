@@ -38,6 +38,74 @@ def run_steps(step, state, key, generations: int):
     return state, history
 
 
+def build_fused_runner(device_step, mesh, n_state: int,
+                       generations: int):
+    """N generations as ONE XLA program: a lax.scan over a per-device
+    step inside a single shard_map — per-generation dispatch overhead
+    disappears (it dominates small-population steps on real
+    accelerators). Shared by every algorithm family.
+
+    ``device_step(*state, key) -> (*state, stats)`` must be the raw
+    per-device function (the body normally wrapped in shard_map), with
+    ``n_state`` replicated state slots. The returned runner maps
+    ``(*state, key) -> (*state, stats_seq)`` with
+    ``stats_seq.shape[0] == generations``.
+    """
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def device_run(*args):
+        state, key = args[:-1], args[-1]
+
+        def body(carry, _):
+            st, key = carry[:-1], carry[-1]
+            key, sub = jax.random.split(key)
+            out = device_step(*st, sub)
+            return (*out[:-1], key), out[-1]
+
+        carry, stats_seq = jax.lax.scan(
+            body, (*state, key), None, length=generations
+        )
+        return (*carry[:-1], stats_seq)
+
+    spec = (P(),) * (n_state + 1)
+    return jax.jit(shard_map(
+        device_run,
+        mesh=mesh,
+        in_specs=spec,
+        out_specs=spec,
+        check_vma=False,
+    ))
+
+
+class _FusedRunMixin:
+    """run_fused() for the state-tuple families. Requires
+    ``self._device_step_fn`` (raw per-device step), ``self.mesh``, and
+    the ``step``/``run`` contract ``state = tuple`` (or NamedTuple).
+    Compiled runners are cached per generation count."""
+
+    def run_fused(self, state, key, generations: int):
+        """Run N generations as one XLA program. Returns
+        (state, stats_seq (generations, k)) — same trajectory as N
+        ``step`` calls with the per-generation key splits."""
+        cache = getattr(self, "_fused_runner_cache", None)
+        if cache is None:
+            cache = self._fused_runner_cache = {}
+        fn = cache.get(generations)
+        if fn is None:
+            fn = build_fused_runner(
+                self._device_step_fn, self.mesh, len(tuple(state)),
+                generations,
+            )
+            cache[generations] = fn
+        out = fn(*tuple(state), key)
+        new_state, stats_seq = out[:-1], out[-1]
+        if hasattr(type(state), "_make"):  # NamedTuple states
+            new_state = type(state)._make(new_state)
+        return new_state, stats_seq
+
+
 def apply_es_update(params, grad, m, v, t, *, lr, wd, adam,
                     b1=0.9, b2=0.999, eps=1e-8):
     """Shared ES parameter update (ascent direction): plain SGD or
@@ -71,7 +139,7 @@ def centered_rank(x):
     return ranks.astype(jnp.float32) / (n - 1) - 0.5
 
 
-class EvolutionStrategy:
+class EvolutionStrategy(_FusedRunMixin):
     """OpenAI-ES with antithetic sampling and rank shaping, compiled as one
     jitted SPMD step over a mesh.
 
@@ -110,7 +178,6 @@ class EvolutionStrategy:
         quantum = 2 * self.n_dev
         self.pop_size = max(quantum, (pop_size // quantum) * quantum)
         self.pairs_per_dev = self.pop_size // quantum
-        self._fused_cache: dict = {}
         # Pallas fused-noise path: regenerate eps instead of storing it
         # (fiber_tpu/ops/pallas_es.py). "auto" resolves to OFF: the
         # fused-program A/B on the chip (bench.py --ab-pallas, recorded
@@ -223,48 +290,16 @@ class EvolutionStrategy:
         )
         return jax.jit(stepped)
 
-    def _build_fused(self, generations: int):
-        """N generations as ONE program: a lax.scan over the device step
-        inside shard_map — per-generation dispatch overhead disappears
-        (it dominates small-population steps on real accelerators)."""
-        import jax
-        from jax.sharding import PartitionSpec as P
-        from jax import shard_map
-
-        fn = self._fused_cache.get(generations)
-        if fn is not None:
-            return fn
-        device_step = self._device_step_fn
-
-        def device_run(params, m, v, t, key):
-            def body(carry, _):
-                params, m, v, t, key = carry
-                key, sub = jax.random.split(key)
-                params, m, v, t, stats = device_step(params, m, v, t, sub)
-                return (params, m, v, t, key), stats
-
-            (params, m, v, t, _), stats_seq = jax.lax.scan(
-                body, (params, m, v, t, key), None, length=generations
-            )
-            return params, m, v, t, stats_seq
-
-        fn = jax.jit(shard_map(
-            device_run,
-            mesh=self.mesh,
-            in_specs=(P(), P(), P(), P(), P()),
-            out_specs=(P(), P(), P(), P(), P()),
-            check_vma=False,
-        ))
-        self._fused_cache[generations] = fn
-        return fn
-
     def run_fused(self, params, key, generations: int):
         """Run N generations in one XLA program. Returns
         (params, stats_history (generations, 3)); optimizer state
-        advances exactly as with per-step run()."""
+        advances exactly as with per-step run(). (Public signature
+        takes bare params — the optimizer state is internal — so this
+        wraps the shared mixin runner around the full state tuple.)"""
         m, v, t = self._ensure_opt_state(params)
-        fn = self._build_fused(generations)
-        params, m, v, t, stats_seq = fn(params, m, v, t, key)
+        state, stats_seq = _FusedRunMixin.run_fused(
+            self, (params, m, v, t), key, generations)
+        params, m, v, t = state
         if self.optimizer == "adam":
             self._opt_state = (m, v, t)
         return params, stats_seq

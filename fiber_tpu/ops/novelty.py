@@ -42,7 +42,7 @@ from __future__ import annotations
 
 from typing import Callable, NamedTuple, Tuple
 
-from fiber_tpu.ops.es import centered_rank
+from fiber_tpu.ops.es import _FusedRunMixin, centered_rank
 
 
 def knn_novelty(bcs, archive, count, k: int):
@@ -91,7 +91,7 @@ class NoveltyState(NamedTuple):
     stag: object         # scalar int32: generations since improvement
 
 
-class NoveltyES:
+class NoveltyES(_FusedRunMixin):
     """NS-ES family on one jitted SPMD step.
 
     ``eval_fn(flat_params, key) -> (fitness, behavior)`` must be pure
@@ -258,6 +258,7 @@ class NoveltyES:
             return (new_params, new_archive, new_count, w_next,
                     best_next, stag_next, stats)
 
+        self._device_step_fn = device_step  # reused by run_fused
         spec = tuple(P() for _ in range(7))
         stepped = shard_map(
             device_step,

@@ -21,10 +21,10 @@ from __future__ import annotations
 
 from typing import Callable, Tuple
 
-from fiber_tpu.ops.es import centered_rank
+from fiber_tpu.ops.es import _FusedRunMixin, centered_rank
 
 
-class PGPE:
+class PGPE(_FusedRunMixin):
     """Antithetic PGPE with centered-rank shaping.
 
     ``eval_fn(flat_params, key) -> scalar fitness`` must be pure and
@@ -117,6 +117,7 @@ class PGPE:
             ])
             return new_mu, new_sigma, stats
 
+        self._device_step_fn = device_step  # reused by run_fused
         stepped = shard_map(
             device_step,
             mesh=self.mesh,

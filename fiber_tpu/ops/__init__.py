@@ -25,5 +25,8 @@ from fiber_tpu.ops.map_elites import (  # noqa: F401
     MapElitesState,
 )
 from fiber_tpu.ops.poet import POET  # noqa: F401
-from fiber_tpu.ops.ring_attention import ring_attention  # noqa: F401
+from fiber_tpu.ops.ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_attention_local,
+)
 from fiber_tpu.ops.ulysses_attention import ulysses_attention  # noqa: F401

@@ -41,64 +41,91 @@ def _block_attn(q, k, v, mask):
 _compiled_cache: dict = {}
 
 
-def _build_ring_attention(mesh, axis: str, causal: bool):
+def ring_attention_local(q_blk, k_blk, v_blk, *, axis: str,
+                         n_devices: int | None = None,
+                         causal: bool = False):
+    """The raw per-device ring-attention body, for COMPOSITION inside a
+    caller's own ``shard_map``.
+
+    ``q_blk/k_blk/v_blk`` are this device's (seq/n_devices, heads,
+    head_dim) shards along a mesh axis named ``axis``; the KV blocks
+    rotate around that axis with ``ppermute`` + online softmax. Because
+    collectives bind by AXIS NAME, this composes freely with other mesh
+    axes — e.g. 2-D data x sequence parallelism: an outer shard_map
+    over ("data", "seq") vmaps this body (axis="seq") over the local
+    batch shard, and every sequence still spans the full seq axis. It
+    also composes with ``vmap`` and jax AD (gradient parity with full
+    attention is pinned in tests). ``n_devices`` defaults to the bound
+    axis's true size (``jax.lax.axis_size``) — pass it only to
+    override, and beware a mismatch silently drops KV blocks.
+    """
     import jax
     import jax.numpy as jnp
+
+    n_dev = (int(jax.lax.axis_size(axis)) if n_devices is None
+             else n_devices)
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+    sq = q_blk.shape[0]
+    h = q_blk.shape[1]
+    my = jax.lax.axis_index(axis)
+    q_pos = my * sq + jnp.arange(sq)            # global query positions
+
+    def accumulate(k_cur, v_cur, src_dev, m, l, o):
+        kv_pos = src_dev * sq + jnp.arange(sq)  # global kv positions
+        mask = None
+        if causal:
+            mask = q_pos[:, None] >= kv_pos[None, :]
+        s = _block_attn(q_blk, k_cur, v_cur, mask)   # (h, sq, skv)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # Guard -inf - -inf (fully masked rows) producing NaN.
+        m_safe = jnp.where(jnp.isinf(m_new), 0.0, m_new)
+        p = jnp.exp(s - m_safe[..., None])
+        if mask is not None:
+            p = jnp.where(mask[None, :, :], p, 0.0)
+        corr = jnp.where(
+            jnp.isinf(m), 0.0, jnp.exp(m - m_safe)
+        )                                            # (h, sq)
+        l_new = l * corr + p.sum(axis=-1)
+        o_corr = o * corr.transpose(1, 0)[:, :, None]
+        o_new = o_corr + jnp.einsum("hqk,khd->qhd", p, v_cur)
+        return m_new, l_new, o_new
+
+    m0 = jnp.full((h, sq), -jnp.inf, q_blk.dtype)
+    l0 = jnp.zeros((h, sq), q_blk.dtype)
+    o0 = jnp.zeros_like(q_blk)                  # (sq, h, d)
+
+    def body(carry, step):
+        # rotate first, then accumulate: the scan covers rotations
+        # 1..n_dev-1, the local block is accumulated outside — so no
+        # final wasted KV rotation ships around the ring.
+        k_cur, v_cur, src_dev, m, l, o = carry
+        k_cur = jax.lax.ppermute(k_cur, axis, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis, perm)
+        src_dev = (src_dev - 1) % n_dev
+        m, l, o = accumulate(k_cur, v_cur, src_dev, m, l, o)
+        return (k_cur, v_cur, src_dev, m, l, o), None
+
+    m, l, o = accumulate(k_blk, v_blk, my, m0, l0, o0)
+    if n_dev > 1:
+        (_, _, _, m, l, o), _ = jax.lax.scan(
+            body, (k_blk, v_blk, my, m, l, o),
+            jnp.arange(n_dev - 1),
+        )
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros
+    return o / l.transpose(1, 0)[:, :, None]
+
+
+def _build_ring_attention(mesh, axis: str, causal: bool):
+    import functools
+
+    import jax
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    n_dev = mesh.shape[axis]
-    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
-
-    def local(q_blk, k_blk, v_blk):
-        sq = q_blk.shape[0]
-        h = q_blk.shape[1]
-        my = jax.lax.axis_index(axis)
-        q_pos = my * sq + jnp.arange(sq)            # global query positions
-
-        def accumulate(k_cur, v_cur, src_dev, m, l, o):
-            kv_pos = src_dev * sq + jnp.arange(sq)  # global kv positions
-            mask = None
-            if causal:
-                mask = q_pos[:, None] >= kv_pos[None, :]
-            s = _block_attn(q_blk, k_cur, v_cur, mask)   # (h, sq, skv)
-            m_new = jnp.maximum(m, s.max(axis=-1))
-            # Guard -inf - -inf (fully masked rows) producing NaN.
-            m_safe = jnp.where(jnp.isinf(m_new), 0.0, m_new)
-            p = jnp.exp(s - m_safe[..., None])
-            if mask is not None:
-                p = jnp.where(mask[None, :, :], p, 0.0)
-            corr = jnp.where(
-                jnp.isinf(m), 0.0, jnp.exp(m - m_safe)
-            )                                            # (h, sq)
-            l_new = l * corr + p.sum(axis=-1)
-            o_corr = o * corr.transpose(1, 0)[:, :, None]
-            o_new = o_corr + jnp.einsum("hqk,khd->qhd", p, v_cur)
-            return m_new, l_new, o_new
-
-        m0 = jnp.full((h, sq), -jnp.inf, q_blk.dtype)
-        l0 = jnp.zeros((h, sq), q_blk.dtype)
-        o0 = jnp.zeros_like(q_blk)                  # (sq, h, d)
-
-        def body(carry, step):
-            # rotate first, then accumulate: the scan covers rotations
-            # 1..n_dev-1, the local block is accumulated outside — so no
-            # final wasted KV rotation ships around the ring.
-            k_cur, v_cur, src_dev, m, l, o = carry
-            k_cur = jax.lax.ppermute(k_cur, axis, perm)
-            v_cur = jax.lax.ppermute(v_cur, axis, perm)
-            src_dev = (src_dev - 1) % n_dev
-            m, l, o = accumulate(k_cur, v_cur, src_dev, m, l, o)
-            return (k_cur, v_cur, src_dev, m, l, o), None
-
-        m, l, o = accumulate(k_blk, v_blk, my, m0, l0, o0)
-        if n_dev > 1:
-            (_, _, _, m, l, o), _ = jax.lax.scan(
-                body, (k_blk, v_blk, my, m, l, o),
-                jnp.arange(n_dev - 1),
-            )
-        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros
-        return o / l.transpose(1, 0)[:, :, None]
+    local = functools.partial(
+        ring_attention_local, axis=axis, n_devices=mesh.shape[axis],
+        causal=causal,
+    )
 
     spec = P(axis)
     return jax.jit(shard_map(

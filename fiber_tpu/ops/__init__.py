@@ -29,4 +29,7 @@ from fiber_tpu.ops.ring_attention import (  # noqa: F401
     ring_attention,
     ring_attention_local,
 )
-from fiber_tpu.ops.ulysses_attention import ulysses_attention  # noqa: F401
+from fiber_tpu.ops.ulysses_attention import (  # noqa: F401
+    ulysses_attention,
+    ulysses_attention_local,
+)

@@ -32,30 +32,48 @@ from __future__ import annotations
 _compiled_cache: dict = {}
 
 
+def ulysses_attention_local(q_blk, k_blk, v_blk, *, axis: str,
+                            causal: bool = False):
+    """The raw per-device Ulysses body, for COMPOSITION inside a
+    caller's own ``shard_map`` (the all-to-alls bind by axis NAME, so
+    it composes with other mesh axes exactly like
+    :func:`fiber_tpu.ops.ring_attention_local` — e.g. a
+    ("data", "seq") 2-D mesh with the body vmapped over the local
+    batch shard). Shards are (seq/n, heads, head_dim);
+    ``heads % axis_size == 0`` required."""
+    import jax
+
+    from fiber_tpu.ops.ring_attention import reference_attention
+
+    # all-to-all #1: scatter heads, gather sequence ->
+    # (seq, heads/n, head_dim); every device now sees the whole
+    # sequence for its head slice.
+    def seq_to_heads(x):
+        return jax.lax.all_to_all(
+            x, axis, split_axis=1, concat_axis=0, tiled=True
+        )
+
+    qh = seq_to_heads(q_blk)
+    kh = seq_to_heads(k_blk)
+    vh = seq_to_heads(v_blk)
+    out = reference_attention(qh, kh, vh, causal=causal)
+    # all-to-all #2: scatter sequence, gather heads — back to the
+    # input layout.
+    return jax.lax.all_to_all(
+        out, axis, split_axis=0, concat_axis=1, tiled=True
+    )
+
+
 def _build(mesh, axis: str, causal: bool):
+    import functools
+
     import jax
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from fiber_tpu.ops.ring_attention import reference_attention
-
-    def local_fn(q, k, v):
-        # local shards: (seq/n, heads, head_dim)
-        # all-to-all #1: scatter heads, gather sequence ->
-        # (seq, heads/n, head_dim); every device now sees the whole
-        # sequence for its head slice.
-        def seq_to_heads(x):
-            return jax.lax.all_to_all(
-                x, axis, split_axis=1, concat_axis=0, tiled=True
-            )
-
-        qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-        out = reference_attention(qh, kh, vh, causal=causal)
-        # all-to-all #2: scatter sequence, gather heads — back to the
-        # input layout.
-        return jax.lax.all_to_all(
-            out, axis, split_axis=0, concat_axis=1, tiled=True
-        )
+    local_fn = functools.partial(
+        ulysses_attention_local, axis=axis, causal=causal
+    )
 
     spec = P(axis)
     return jax.jit(shard_map(

@@ -1352,6 +1352,17 @@ def test_ring_attention_local_composes_2d_data_seq_mesh():
     )(q, k, v)))
     assert np.abs(got - want).max() < 1e-5
 
+    # Gradients flow through the 2-D composition too — dp x sp is a
+    # TRAINING configuration, not a forward-only trick.
+    g = jax.grad(lambda q, k, v: jnp.sum(fn(q, k, v) ** 2),
+                 argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(jax.vmap(
+            lambda q, k, v: reference_attention(q, k, v, causal=True)
+        )(q, k, v) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        assert float(jnp.abs(a - b).max()) < 1e-4
+
 
 def test_ulysses_attention_local_composes_2d_data_seq_mesh():
     """Same 2-D data x sequence composition for the Ulysses body: the
@@ -1387,3 +1398,16 @@ def test_ulysses_attention_local_composes_2d_data_seq_mesh():
         lambda q, k, v: reference_attention(q, k, v, causal=True)
     )(q, k, v)))
     assert np.abs(got - want).max() < 1e-5
+
+    # Gradient parity through the 2-D composition (all_to_all VJP
+    # under the outer shard_map) — same training pin as the ring test.
+    import jax.numpy as jnp
+
+    g = jax.grad(lambda q, k, v: jnp.sum(fn(q, k, v) ** 2),
+                 argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(jax.vmap(
+            lambda q, k, v: reference_attention(q, k, v, causal=True)
+        )(q, k, v) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        assert float(jnp.abs(a - b).max()) < 1e-4

@@ -1,0 +1,36 @@
+"""Count lines of every example, in parallel — the reference's
+smallest Pool demo (reference: examples/line_count.py), unchanged in
+spirit: Pool.map of a plain-Python function over a file list.
+
+Run:  python examples/line_count.py
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+
+from pathlib import Path
+
+
+def line_count(fname):
+    with open(fname) as f:
+        return len(f.readlines())
+
+
+def main():
+    import fiber_tpu
+
+    here = Path(__file__).parent
+    files = sorted(str(p) for p in here.glob("*.py"))
+    with fiber_tpu.Pool(4) as pool:
+        counts = pool.map(line_count, files)
+    for f, c in zip(files, counts):
+        print(f"{Path(f).name}\t{c}")
+    print(f"{len(files)} files counted")
+
+
+if __name__ == "__main__":
+    main()

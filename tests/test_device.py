@@ -1311,6 +1311,25 @@ def test_state_family_run_fused_matches_steps():
             assert type(s_fused).__name__ == "NoveltyState"
 
 
+def _assert_2d_grad_parity(fn, q, k, v, tol=1e-4):
+    """Gradients THROUGH a composed 2-D attention fn must match the
+    vmapped full-attention reference — pins dp x sp as a training
+    configuration, not a forward-only trick."""
+    import jax
+    import jax.numpy as jnp
+
+    from fiber_tpu.ops.ring_attention import reference_attention
+
+    g = jax.grad(lambda q, k, v: jnp.sum(fn(q, k, v) ** 2),
+                 argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(jax.vmap(
+            lambda q, k, v: reference_attention(q, k, v, causal=True)
+        )(q, k, v) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        assert float(jnp.abs(a - b).max()) < tol
+
+
 def test_ring_attention_local_composes_2d_data_seq_mesh():
     """2-D data x sequence parallelism: ring_attention_local (the raw
     per-device body, collectives bound by axis NAME) vmapped over the
@@ -1352,16 +1371,7 @@ def test_ring_attention_local_composes_2d_data_seq_mesh():
     )(q, k, v)))
     assert np.abs(got - want).max() < 1e-5
 
-    # Gradients flow through the 2-D composition too — dp x sp is a
-    # TRAINING configuration, not a forward-only trick.
-    g = jax.grad(lambda q, k, v: jnp.sum(fn(q, k, v) ** 2),
-                 argnums=(0, 1, 2))(q, k, v)
-    g_ref = jax.grad(
-        lambda q, k, v: jnp.sum(jax.vmap(
-            lambda q, k, v: reference_attention(q, k, v, causal=True)
-        )(q, k, v) ** 2), argnums=(0, 1, 2))(q, k, v)
-    for a, b in zip(g, g_ref):
-        assert float(jnp.abs(a - b).max()) < 1e-4
+    _assert_2d_grad_parity(fn, q, k, v)
 
 
 def test_ulysses_attention_local_composes_2d_data_seq_mesh():
@@ -1399,15 +1409,4 @@ def test_ulysses_attention_local_composes_2d_data_seq_mesh():
     )(q, k, v)))
     assert np.abs(got - want).max() < 1e-5
 
-    # Gradient parity through the 2-D composition (all_to_all VJP
-    # under the outer shard_map) — same training pin as the ring test.
-    import jax.numpy as jnp
-
-    g = jax.grad(lambda q, k, v: jnp.sum(fn(q, k, v) ** 2),
-                 argnums=(0, 1, 2))(q, k, v)
-    g_ref = jax.grad(
-        lambda q, k, v: jnp.sum(jax.vmap(
-            lambda q, k, v: reference_attention(q, k, v, causal=True)
-        )(q, k, v) ** 2), argnums=(0, 1, 2))(q, k, v)
-    for a, b in zip(g, g_ref):
-        assert float(jnp.abs(a - b).max()) < 1e-4
+    _assert_2d_grad_parity(fn, q, k, v)

@@ -69,3 +69,15 @@ def test_map_elites_maze_example():
                "--cells", "6", timeout=480)
     assert "coverage" in out
     assert "map-elites done" in out
+
+
+def test_line_count_example():
+    out = _run("line_count.py")
+    assert "files counted" in out
+
+
+def test_shared_data_example():
+    """Manager nested-object semantics demo (assign-back rules match
+    the reference's shared_data example)."""
+    out = _run("shared_data.py", timeout=300)
+    assert "shared data semantics demonstrated" in out

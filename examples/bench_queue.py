@@ -32,6 +32,9 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--msgs", type=int, default=20_000)
     parser.add_argument("--size", type=int, default=1024)
+    parser.add_argument("--prefetch", type=int, default=1,
+                        help="consumer credit window (1 = pure "
+                             "demand-driven; N pipelines N messages)")
     parser.add_argument("--stream", action="store_true",
                         help="one-way streaming throughput instead of "
                              "round-trips (round-trips measure latency; "
@@ -41,7 +44,8 @@ def main():
     import fiber_tpu
 
     if args.stream:
-        q_in, q_done = fiber_tpu.SimpleQueue(), fiber_tpu.SimpleQueue()
+        q_in = fiber_tpu.SimpleQueue(prefetch=args.prefetch)
+        q_done = fiber_tpu.SimpleQueue()
         p = fiber_tpu.Process(target=drain_worker,
                               args=(q_in, q_done, args.msgs))
         p.start()

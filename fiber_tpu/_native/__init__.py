@@ -108,6 +108,10 @@ def load() -> Optional[ctypes.CDLL]:
         lib.fiber_pump_close.argtypes = [ctypes.c_void_p]
         lib.fiber_pump_peers.restype = ctypes.c_int
         lib.fiber_pump_peers.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        if hasattr(lib, "nq_set_prefetch"):
+            lib.nq_set_prefetch.restype = None
+            lib.nq_set_prefetch.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_int]
         lib.nq_connect.restype = ctypes.c_void_p
         lib.nq_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                    ctypes.c_int, ctypes.c_int,
@@ -209,7 +213,8 @@ class NativeClient:
 
     CONNECT_TIMEOUT_MS = 30_000
 
-    def __init__(self, host: str, port: int, mode: str) -> None:
+    def __init__(self, host: str, port: int, mode: str,
+                 prefetch: int = 1) -> None:
         lib = load()
         if lib is None:
             raise RuntimeError("native client unavailable")
@@ -221,6 +226,10 @@ class NativeClient:
                                 self.CONNECT_TIMEOUT_MS, key, len(key))
         if not handle:
             raise OSError(f"nq_connect failed for {host}:{port}")
+        if prefetch > 1 and hasattr(lib, "nq_set_prefetch"):
+            # r-mode credit window; a stale cached .so without the
+            # symbol silently keeps the demand-driven default.
+            lib.nq_set_prefetch(handle, int(prefetch))
         self._lib = lib
         self._handle = handle
         self._op_lock = threading.Lock()

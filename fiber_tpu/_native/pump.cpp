@@ -611,6 +611,7 @@ struct Client {
   int mode = 0;            // 0 r, 1 w, 2 rw
   uint64_t credit = 0;     // w-mode: frames the peer will accept
   int credit_outstanding = 0;  // r-mode: granted but undelivered
+  int prefetch = 1;        // r-mode credit window (1 = pure demand)
   std::vector<uint8_t> rbuf;
   size_t rpos = 0;
 };
@@ -807,16 +808,18 @@ int nq_send(void* handle, const uint8_t* payload, uint64_t len) {
 int nq_recv(void* handle, int timeout_ms, uint8_t** out,
             uint64_t* out_len) {
   Client* c = static_cast<Client*>(handle);
-  if (c->mode == 0 && c->credit_outstanding == 0) {
-    if (!client_send_credit(c, 1)) return -1;
-    c->credit_outstanding = 1;
+  if (c->mode == 0 && c->credit_outstanding < c->prefetch) {
+    uint32_t want = uint32_t(c->prefetch - c->credit_outstanding);
+    if (!client_send_credit(c, want)) return -1;
+    c->credit_outstanding = c->prefetch;
   }
   for (;;) {
     uint8_t type;
     int rc = client_read_frame(c, timeout_ms, &type, out, out_len);
     if (rc != 1) return rc;
     if (type == 0x00) {
-      if (c->mode == 0) c->credit_outstanding = 0;
+      if (c->mode == 0 && c->credit_outstanding > 0)
+        c->credit_outstanding--;
       return 1;
     }
     if (type == 0x01 && *out_len >= 4) c->credit += be32(*out);
@@ -825,6 +828,14 @@ int nq_recv(void* handle, int timeout_ms, uint8_t** out,
 }
 
 void nq_free(uint8_t* ptr) { free(ptr); }
+
+// r-mode credit window: n > 1 pipelines up to n frames toward this
+// consumer (throughput); 1 restores pure demand-driven delivery (a dead
+// consumer never has more than the granted window parked in its socket).
+void nq_set_prefetch(void* handle, int n) {
+  Client* c = static_cast<Client*>(handle);
+  c->prefetch = n < 1 ? 1 : n;
+}
 
 int nq_fileno(void* handle) {
   return static_cast<Client*>(handle)->fd;
@@ -839,8 +850,11 @@ int nq_poll(void* handle, int timeout_ms) {
   if (c->rbuf.size() - c->rpos >= 9) return 1;
   // Demand-driven consumers must ask before anything can arrive — a poll
   // without a granted credit would always time out (the canonical
-  // "if conn.poll(t): conn.recv()" pattern depends on this).
-  if (c->mode == 0 && c->credit_outstanding == 0) {
+  // "if conn.poll(t): conn.recv()" pattern depends on this). Polling is
+  // NOT consuming: grant at most ONE demand credit, and none for a
+  // zero-timeout peek (matches the Python endpoint) — otherwise an
+  // empty()-only caller would hoard the whole prefetch window.
+  if (c->mode == 0 && timeout_ms != 0 && c->credit_outstanding == 0) {
     if (!client_send_credit(c, 1)) return -1;
     c->credit_outstanding = 1;
   }

@@ -57,10 +57,10 @@ class FiberContext:
         )
 
     # -- queues / pipes ----------------------------------------------------
-    def SimpleQueue(self):
+    def SimpleQueue(self, prefetch: int = 1):
         from fiber_tpu.queues import SimpleQueue
 
-        return SimpleQueue()
+        return SimpleQueue(prefetch=prefetch)
 
     def Pipe(self, duplex: bool = True):
         from fiber_tpu.queues import Pipe

@@ -39,9 +39,10 @@ class Connection:
     (pickled objects), send_bytes/recv_bytes, poll, fileno, close.
     """
 
-    def __init__(self, mode: str, addr: str) -> None:
+    def __init__(self, mode: str, addr: str, prefetch: int = 1) -> None:
         self._mode = mode
         self._addr = addr
+        self._prefetch = max(1, int(prefetch))
         self._ep: Optional[Endpoint] = None
         self._lock = threading.Lock()
 
@@ -59,7 +60,8 @@ class Connection:
     def _connect_impl(self):
         from fiber_tpu.transport.tcp import connect_transport
 
-        return connect_transport(self._mode, self._addr)
+        return connect_transport(self._mode, self._addr,
+                                 prefetch=self._prefetch)
 
     # -- data -------------------------------------------------------------
     def send_bytes(self, payload: bytes) -> None:
@@ -94,11 +96,16 @@ class Connection:
             device_ref.release()
 
     # -- pickling ---------------------------------------------------------
-    def __getstate__(self) -> Tuple[str, str]:
-        return (self._mode, self._addr)
+    def __getstate__(self):
+        return (self._mode, self._addr, self._prefetch)
 
-    def __setstate__(self, state: Tuple[str, str]) -> None:
-        self._mode, self._addr = state
+    def __setstate__(self, state) -> None:
+        # Older pickles carry (mode, addr); newer add prefetch.
+        if len(state) == 2:
+            self._mode, self._addr = state
+            self._prefetch = 1
+        else:
+            self._mode, self._addr, self._prefetch = state
         self._ep = None
         self._lock = threading.Lock()
 
@@ -156,7 +163,14 @@ class SimpleQueue:
     fairness by the reference suite).
     """
 
-    def __init__(self) -> None:
+    def __init__(self, prefetch: int = 1) -> None:
+        # prefetch=1 (default): pure demand-driven delivery — a dead
+        # consumer never has undelivered messages parked in its socket
+        # (the loss-free contract). prefetch=N>1: each consumer keeps a
+        # bounded window of N messages in flight — much higher one-way
+        # throughput, at the cost of up to N messages parked in a
+        # consumer that dies mid-stream.
+        self.prefetch = max(1, int(prefetch))
         ip = _listen_ip()
         self._device: Optional[Device] = Device("r", "w", ip)
         self._in_addr = self._device.in_addr
@@ -172,7 +186,8 @@ class SimpleQueue:
 
     def _get_reader(self) -> Connection:
         if self._reader is None:
-            self._reader = Connection("r", self._out_addr)
+            self._reader = Connection("r", self._out_addr,
+                                      prefetch=self.prefetch)
         return self._reader
 
     # -- queue API --------------------------------------------------------
@@ -209,10 +224,14 @@ class SimpleQueue:
 
     # -- pickling ---------------------------------------------------------
     def __getstate__(self):
-        return (self._in_addr, self._out_addr)
+        return (self._in_addr, self._out_addr, self.prefetch)
 
     def __setstate__(self, state) -> None:
-        self._in_addr, self._out_addr = state
+        if len(state) == 2:  # older pickles
+            self._in_addr, self._out_addr = state
+            self.prefetch = 1
+        else:
+            self._in_addr, self._out_addr, self.prefetch = state
         self._device = None
         self._writer = None
         self._reader = None

@@ -127,6 +127,14 @@ class _Channel:
                         self.credit += n
                         self.owner._chan_lock.notify_all()
                 else:
+                    # Arrival consumes the credit that pulled it: count
+                    # each undelivered frame ONCE (inbox qsize), so the
+                    # prefetch window arithmetic in _maybe_grant doesn't
+                    # double-count frames as both queued and outstanding.
+                    if self.owner._demand_driven:
+                        with self.owner._recv_lock:
+                            if self.owner._credit_outstanding > 0:
+                                self.owner._credit_outstanding -= 1
                     self.owner._inbox.put((self, frame[1:]))
         except (ConnectionClosed, OSError):
             pass
@@ -150,10 +158,14 @@ class _Channel:
 
 
 class Endpoint:
-    def __init__(self, mode: str) -> None:
+    def __init__(self, mode: str, prefetch: int = 1) -> None:
         if mode not in MODES:
             raise ValueError(f"invalid endpoint mode {mode!r}")
         self.mode = mode
+        # r-mode credit window: 1 = pure demand-driven (a dead consumer
+        # never has frames parked beyond what a blocked reader asked
+        # for); >1 pipelines a bounded window for throughput.
+        self.prefetch = max(1, int(prefetch))
         self._inbox = _Inbox()
         self._channels: List[_Channel] = []
         self._chan_lock = threading.Condition()
@@ -330,20 +342,29 @@ class Endpoint:
                 except OSError:
                     self._drop_channel(chan)
 
-    def _maybe_grant(self) -> None:
-        """Demand-driven credit for connected r-endpoints: grant one credit
-        per reader actually waiting, never more (a dead consumer therefore
-        never has frames parked in its socket buffer)."""
+    def _maybe_grant(self, pipeline: bool = True) -> None:
+        """Credit for connected r-endpoints. With prefetch=1 (default):
+        grant one credit per reader actually waiting, never more (a dead
+        consumer therefore never has frames parked in its socket
+        buffer). With prefetch>1: keep a bounded window of credits in
+        flight once a reader has engaged — higher throughput, at most
+        `prefetch` undelivered frames pulled toward a consumer that
+        dies. ``pipeline=False`` (the poll path) grants demand-only:
+        polling is not consuming, so an empty()-style caller must not
+        hoard the window."""
         with self._recv_lock:
-            if (self._inbox.qsize() + self._credit_outstanding
-                    >= self._waiting_readers):
+            want = self._waiting_readers
+            if pipeline and self._waiting_readers:
+                want = max(want, self.prefetch)
+            grant = want - self._inbox.qsize() - self._credit_outstanding
+            if grant <= 0:
                 return
-            self._credit_outstanding += 1
+            self._credit_outstanding += grant
         with self._chan_lock:
             chan = self._channels[0] if self._channels else None
         if chan is not None:
             try:
-                chan.send_credit(1)
+                chan.send_credit(grant)
             except OSError:
                 pass
 
@@ -374,7 +395,6 @@ class Endpoint:
         chan, frame = item
         if demand:
             with self._recv_lock:
-                self._credit_outstanding -= 1
                 self._waiting_readers -= 1
             self._maybe_grant()  # top up for any other blocked readers
         elif self.mode == "r":
@@ -407,7 +427,7 @@ class Endpoint:
         if self._demand_driven:
             with self._recv_lock:
                 self._waiting_readers += 1
-            self._maybe_grant()
+            self._maybe_grant(pipeline=False)
         try:
             item = self._inbox.peek(timeout=timeout)
             return item is not _SENTINEL_EMPTY and item is not _SENTINEL
@@ -477,7 +497,8 @@ def parse_addr(addr: str) -> Tuple[str, int]:
 _NATIVE_MODE_MAP = {"r": "r", "w": "w", "rw": "rw", "req": "rw"}
 
 
-def connect_transport(mode: str, addr: str, native: bool = True):
+def connect_transport(mode: str, addr: str, native: bool = True,
+                      prefetch: int = 1):
     """The one place that picks a connection-side transport: the native C
     client (framing + socket + credit protocol per ctypes call) when the
     library loads and the address is a numeric IPv4, else a Python
@@ -496,10 +517,11 @@ def connect_transport(mode: str, addr: str, native: bool = True):
             from fiber_tpu._native import NativeClient, available
 
             if available():
-                return NativeClient(host, port, native_mode)
+                return NativeClient(host, port, native_mode,
+                                    prefetch=prefetch)
         except Exception:
             pass
-    return Endpoint(mode).connect(addr)
+    return Endpoint(mode, prefetch=prefetch).connect(addr)
 
 
 class Device:

@@ -151,3 +151,28 @@ def test_jax_arrays_through_queue():
     assert p.exitcode == 0
     q_in.close()
     q_out.close()
+
+
+def test_simple_queue_prefetch_stream():
+    """SimpleQueue(prefetch=N) pipelines messages for throughput while
+    delivering every message exactly once, in order, to one consumer
+    (whichever transport implementation — native or Python — is live);
+    pickled copies carry the window; old 2-tuple pickles still load."""
+    q = fiber_tpu.SimpleQueue(prefetch=32)
+    n = 500
+    for i in range(n):
+        q.put(i)
+    got = [q.get(10) for _ in range(n)]
+    assert got == list(range(n))
+
+    import pickle
+
+    q2 = pickle.loads(pickle.dumps(q))
+    assert q2.prefetch == 32
+    # backward compat: pre-prefetch pickles are a 2-tuple
+    from fiber_tpu.queues import SimpleQueue as SQ
+
+    q3 = SQ.__new__(SQ)
+    q3.__setstate__((q._in_addr, q._out_addr))
+    assert q3.prefetch == 1
+    q.close()

@@ -26,9 +26,10 @@ def main():
     parser.add_argument("--optimizer", default="sgd",
                         choices=("sgd", "adam"))
     parser.add_argument("--algo", default="es",
-                        choices=("es", "pgpe", "cma"),
+                        choices=("es", "pgpe", "cma", "fullcma"),
                         help="algorithm family: OpenAI-ES (default), "
-                             "PGPE, or sep-CMA-ES")
+                             "PGPE, sep-CMA-ES, or full-covariance "
+                             "CMA-ES")
     parser.add_argument("--fused", action="store_true",
                         help="run generations as fused lax.scan chunks")
     args = parser.parse_args()
@@ -46,15 +47,24 @@ def main():
                                 max_steps=args.steps)
 
     if args.algo != "es":
-        if args.fused or args.optimizer != "sgd":
-            parser.error("--fused/--optimizer apply only to --algo es")
-        from fiber_tpu.ops import PGPE, SepCMAES
+        if args.optimizer != "sgd":
+            parser.error("--optimizer applies only to --algo es")
+        from fiber_tpu.ops import CMAES, PGPE, SepCMAES
 
-        cls = PGPE if args.algo == "pgpe" else SepCMAES
+        cls = {"pgpe": PGPE, "cma": SepCMAES,
+               "fullcma": CMAES}[args.algo]
         opt = cls(eval_fn, dim=policy.dim, pop_size=args.pop)
         state = opt.init_state(policy.init(jax.random.PRNGKey(0)))
         t0 = time.time()
-        state, hist = opt.run(state, jax.random.PRNGKey(1), args.gens)
+        if args.fused:
+            # One XLA program for all generations (the shared fused
+            # runner every state-tuple family now carries).
+            state, stats_seq = opt.run_fused(
+                state, jax.random.PRNGKey(1), args.gens)
+            hist = list(jax.device_get(stats_seq))
+        else:
+            state, hist = opt.run(state, jax.random.PRNGKey(1),
+                                  args.gens)
         jax.block_until_ready(state[0])
         elapsed = time.time() - t0
         every = max(1, args.gens // 10)

@@ -401,8 +401,14 @@ class DeceptiveMaze:
                 & (jnp.abs(x_cross) <= cls.WALL_HALF)
             stop_y = jnp.where(pos[1] < cls.WALL_Y,
                                cls.WALL_Y - 1e-3, cls.WALL_Y + 1e-3)
+            # Blocked steps park at the intersection point (x_cross,
+            # stop_y), not (new_x, stop_y): keeping the full lateral
+            # displacement would re-open the corner cut over two steps
+            # (advisor, round 2) — strict wall physics is what makes the
+            # maze deceptive for plain ES.
+            new_x = jnp.where(crosses, x_cross, new[0])
             new_y = jnp.where(crosses, stop_y, new[1])
-            return jnp.stack([new[0], new_y]), None
+            return jnp.stack([new_x, new_y]), None
 
         pos, _ = jax.lax.scan(
             scan_step, pos0, None, length=steps, unroll=_scan_unroll()

@@ -77,6 +77,15 @@ class Connection:
         return serialization.loads(self.recv_bytes(timeout))
 
     def poll(self, timeout: Optional[float] = 0.0) -> bool:
+        """True if a message is ready (or arrives within ``timeout``).
+
+        ``poll(0)`` only reports messages already delivered locally: on a
+        demand-driven (connected read) end it does NOT request a frame
+        from the producer, so a consumer that only ever zero-timeout
+        polls will never observe data on an idle connection. Poll with a
+        timeout (or call ``recv``) to express demand — polling is not
+        consuming, and a pure ``empty()``-style loop must not pull frames
+        toward an endpoint that may never read them."""
         return self._endpoint().poll(timeout)
 
     def fileno(self) -> int:
@@ -201,7 +210,12 @@ class SimpleQueue:
             raise pyqueue.Empty from None
 
     def empty(self) -> bool:
-        """Approximate: True if no message is locally available."""
+        """Approximate: True if no message is locally available.
+
+        Like ``Connection.poll(0)``, this never requests a frame from the
+        producer — an ``empty()``-only loop on an idle connected reader
+        stays True forever; interleave ``get`` (or a timed ``poll``) to
+        actually pull messages."""
         return not self._get_reader().poll(0.0)
 
     def wait_consumers(self, n: int, timeout: Optional[float] = None) -> bool:

@@ -131,11 +131,21 @@ class _Channel:
                     # each undelivered frame ONCE (inbox qsize), so the
                     # prefetch window arithmetic in _maybe_grant doesn't
                     # double-count frames as both queued and outstanding.
+                    # Enqueue and decrement under the lock _maybe_grant
+                    # holds: decrementing before enqueueing (the old
+                    # order) let a concurrent grant see neither the
+                    # queued frame nor the outstanding credit and
+                    # over-grant past the parked-frame bound (advisor,
+                    # round 2). _Inbox locks are leaf-level and readers
+                    # never block holding _recv_lock, so this nesting
+                    # cannot deadlock.
                     if self.owner._demand_driven:
                         with self.owner._recv_lock:
+                            self.owner._inbox.put((self, frame[1:]))
                             if self.owner._credit_outstanding > 0:
                                 self.owner._credit_outstanding -= 1
-                    self.owner._inbox.put((self, frame[1:]))
+                    else:
+                        self.owner._inbox.put((self, frame[1:]))
         except (ConnectionClosed, OSError):
             pass
         finally:

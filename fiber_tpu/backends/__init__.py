@@ -16,6 +16,7 @@ from __future__ import annotations
 import importlib
 import os
 import threading
+import time
 from typing import Dict, Optional
 
 from fiber_tpu import config
@@ -29,7 +30,16 @@ _BACKEND_MODULES: Dict[str, str] = {
 }
 
 _backends: Dict[str, Backend] = {}
+# Sniffed selections that probed unavailable -> monotonic deadline after
+# which the probe is retried (agents may simply not be up YET on a real
+# pod; a single transient failure must not pin a long-lived driver to
+# the local backend forever). Until the deadline, later get_backend()
+# calls skip the probe cost. Explicit selection (FIBER_BACKEND / config
+# / name argument) bypasses this; reset_backends() clears it.
+_failed_sniffs: Dict[str, float] = {}
+_SNIFF_RETRY_S = 60.0
 _lock = threading.Lock()
+_build_locks: Dict[str, threading.Lock] = {}
 
 
 def _on_tpu_pod() -> bool:
@@ -72,18 +82,49 @@ def get_backend(name: Optional[str] = None) -> Backend:
     if name is None:
         name, explicit = _select_backend()
         sniffed = not explicit
+        if sniffed:
+            deadline = _failed_sniffs.get(name)
+            if deadline is not None:
+                if time.monotonic() < deadline:
+                    return get_backend("local")
+                _failed_sniffs.pop(name, None)  # retry the probe
     try:
         with _lock:
             backend = _backends.get(name)
-            if backend is None:
-                modname = _BACKEND_MODULES.get(name)
-                if modname is None:
-                    raise ValueError(
-                        f"unknown backend {name!r}; "
-                        f"available: {available_backends}"
-                    )
-                module = importlib.import_module(modname)
-                backend = module.make_backend()
+            if backend is not None:
+                return backend
+            # Per-name build lock so construction and (for sniffed
+            # selections) the reachability probe — up to 2s of connect
+            # timeout per host — never run under the registry lock:
+            # concurrent get_backend("local") calls must not stall
+            # behind a slow tpu probe.
+            build_lock = _build_locks.setdefault(name, threading.Lock())
+        with build_lock:
+            with _lock:
+                backend = _backends.get(name)
+                if backend is not None:
+                    return backend
+            modname = _BACKEND_MODULES.get(name)
+            if modname is None:
+                raise ValueError(
+                    f"unknown backend {name!r}; "
+                    f"available: {available_backends}"
+                )
+            module = importlib.import_module(modname)
+            backend = module.make_backend()
+            if sniffed:
+                # A sniffed selection must actually work before it is
+                # memoized: TPU-shaped environments exist where no
+                # host agent runs (e.g. a tunnel plugin injecting
+                # TPU_WORKER_HOSTNAMES into every interpreter), and
+                # accepting the backend there turns every Process
+                # start into a connection-refused retry loop. An
+                # explicit selection skips the probe — the operator
+                # said tpu, so failing loudly at create_job is right.
+                probe = getattr(backend, "probe_available", None)
+                if probe is not None:
+                    probe()
+            with _lock:
                 _backends[name] = backend
             return backend
     except Exception:
@@ -95,6 +136,7 @@ def get_backend(name: Optional[str] = None) -> Backend:
             "auto-selected backend %r unavailable; falling back to 'local'",
             name, exc_info=True,
         )
+        _failed_sniffs[name] = time.monotonic() + _SNIFF_RETRY_S
         return get_backend("local")
 
 
@@ -102,3 +144,4 @@ def reset_backends() -> None:
     """Drop memoized backends (tests)."""
     with _lock:
         _backends.clear()
+    _failed_sniffs.clear()

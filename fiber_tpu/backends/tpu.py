@@ -144,11 +144,20 @@ class TpuBackend(Backend):
         # runs from (a bare `-m fiber_tpu.host_agent` only works when cwd
         # happens to contain the package).
         env = dict(os.environ, PYTHONPATH=package_pythonpath())
+        # Each sim agent models a whole pod HOST, so it advertises a
+        # host-sized core capacity regardless of this machine's physical
+        # count (the agents share cores, like the reference's Docker
+        # containers): otherwise packed jobs (cpu_per_job>1) would be
+        # unspawnable on small CI machines and the pool would retry
+        # forever. Override with FIBER_SIM_HOST_CORES.
+        sim_cores = int(os.environ.get("FIBER_SIM_HOST_CORES", 0)) \
+            or max(8, os.cpu_count() or 1)
         hosts = []
         for _ in range(n):
             proc = subprocess.Popen(
                 [sys.executable, "-m", "fiber_tpu.host_agent",
-                 "--port", "0", "--announce", "--bind", "127.0.0.1"],
+                 "--port", "0", "--announce", "--bind", "127.0.0.1",
+                 "--cores", str(sim_cores)],
                 stdout=subprocess.PIPE,
                 stderr=subprocess.DEVNULL,
                 text=True,
@@ -175,6 +184,40 @@ class TpuBackend(Backend):
             except subprocess.TimeoutExpired:
                 proc.kill()
         self._sim_agents = []
+
+    def probe_available(self) -> None:
+        """Raise unless at least one host agent is reachable. Called by
+        the registry for *sniffed* (non-explicit) selections only: a
+        TPU-shaped environment without running agents (e.g. a PJRT
+        tunnel plugin injecting TPU_WORKER_HOSTNAMES) must fall back to
+        the local backend instead of turning every job launch into a
+        connection-refused retry loop. Sim clusters spawn their own
+        agents in __init__, so they always pass."""
+        import socket as pysocket
+        from concurrent.futures import ThreadPoolExecutor
+
+        def try_one(host_port):
+            host, port = host_port
+            try:
+                with pysocket.create_connection((host, port), timeout=2.0):
+                    return None
+            except OSError as exc:
+                return f"{host}:{port}: {exc}"
+
+        # Concurrent probes: the failure path costs ~one connect timeout
+        # total, not 2s x hosts (first success wins either way).
+        with ThreadPoolExecutor(max_workers=min(16, len(self._hosts))) \
+                as pool:
+            errors = [e for e in pool.map(try_one, self._hosts)
+                      if e is not None]
+        if len(errors) < len(self._hosts):
+            return  # at least one agent answered
+        raise RuntimeError(
+            "no fiber-tpu host agent reachable "
+            f"({'; '.join(errors[:4])}) — start agents with "
+            "`fiber-tpu up` / `fiber-tpu agent`, or set "
+            "FIBER_BACKEND=local"
+        )
 
     def _agent(self, host: Tuple[str, int]) -> AgentClient:
         with self._lock:

@@ -67,7 +67,8 @@ class HostAgent:
                  staging_root: Optional[str] = None,
                  restrict_files: bool = True,
                  strict_resources: bool = False,
-                 exit_on_shutdown: bool = False) -> None:
+                 exit_on_shutdown: bool = False,
+                 cores: Optional[int] = None) -> None:
         if (bind not in ("127.0.0.1", "localhost")
                 and authkey is None
                 and "FIBER_CLUSTER_KEY" not in os.environ):
@@ -88,6 +89,13 @@ class HostAgent:
         # this host (off by default — sim clusters run many agents on one
         # machine and must share cores).
         self._strict_resources = strict_resources
+        # Advertised core capacity. Defaults to the physical count; a sim
+        # cluster overrides it upward because its N agents model N *hosts*
+        # sharing one machine (the reference's Docker-backend posture —
+        # containers share host cores, fiber/docker_backend.py mounts no
+        # cpuset): reservation math is validated against the advertised
+        # capacity, physical cores are shared.
+        self._cores = int(cores) if cores else (os.cpu_count() or 1)
         self._core_rr = 0  # rotating start for affinity placement
         self._pending_cpu = 0  # reservations between check and job insert
         # Standalone daemons hard-exit on the shutdown op; embedded agents
@@ -183,7 +191,7 @@ class HostAgent:
         limits = limits or {}
         cpu = limits.get("cpu")
         mem = limits.get("mem")
-        ncpu = os.cpu_count() or 1
+        ncpu = self._cores
         if cpu and cpu > ncpu:
             raise ValueError(
                 f"cpu reservation {cpu} exceeds host cores {ncpu}"
@@ -347,7 +355,8 @@ class HostAgent:
     def _op_host_info(self) -> dict:
         return {
             "pid": os.getpid(),
-            "cpu_count": os.cpu_count(),
+            "cpu_count": self._cores,
+            "physical_cpu_count": os.cpu_count(),
             "cwd": os.getcwd(),
             "python": sys.executable,
             "staging_root": self._staging_root,
@@ -384,12 +393,17 @@ def main(argv=None) -> int:
     parser.add_argument("--strict-resources", action="store_true",
                         help="reject spawns whose cpu reservations would "
                              "oversubscribe this host")
+    parser.add_argument("--cores", type=int, default=0,
+                        help="advertised core capacity (default: physical "
+                             "cpu count; sim clusters raise it — N agents "
+                             "on one machine model N hosts sharing cores)")
     args = parser.parse_args(argv)
     agent = HostAgent(args.port, bind=args.bind,
                       staging_root=args.staging_root,
                       restrict_files=not args.unrestricted_files,
                       strict_resources=args.strict_resources,
-                      exit_on_shutdown=True)
+                      exit_on_shutdown=True,
+                      cores=args.cores)
     if args.announce:
         print(f"AGENT_PORT {agent.port}", flush=True)
     # Die with the parent where supported (sim clusters).

@@ -168,11 +168,12 @@ def test_spawn_enforces_mem_rlimit(sim_backend):
 
 
 def test_spawn_rejects_overcommitted_cpu(sim_backend):
-    """A single reservation larger than the host is refused outright."""
-    import os
-
+    """A single reservation larger than the host's ADVERTISED capacity is
+    refused outright (sim agents advertise max(8, physical) virtual
+    cores, so the bound is queried, not os.cpu_count())."""
+    info = sim_backend._agent(sim_backend._hosts[0]).call("host_info")
     spec = JobSpec(command=[sys.executable, "-c", "pass"],
-                   cpu=(os.cpu_count() or 1) + 1)
+                   cpu=int(info["cpu_count"]) + 1)
     with pytest.raises(Exception, match="exceeds host cores"):
         sim_backend.create_job(spec)
 

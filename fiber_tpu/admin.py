@@ -131,7 +131,13 @@ class AdminServer:
                 data += chunk
             (ident,) = _IDENT.unpack(data)
             conn.settimeout(None)
-        except OSError:
+        except OSError as exc:
+            # Never silent: this close RESETS the dialing worker (it dies
+            # at prep recv with ECONNRESET and the launcher then reports
+            # "exited before connecting back" with no cause in sight) —
+            # the log line is the only place the real reason survives.
+            logger.warning("admin: ident handshake from %s failed: %r",
+                           addr, exc)
             conn.close()
             return
         with self._lock:

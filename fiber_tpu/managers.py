@@ -499,13 +499,28 @@ class BaseManager:
         self._authkey = bytes(current_process().authkey)
         reader, writer = Pipe(duplex=False)
         factories = {tid: fac for tid, (fac, _) in self._registry.items()}
-        self._process = Process(
-            target=_run_server,
-            args=(factories, writer, self._authkey),
-            name=f"Manager-{id(self):x}",
-            daemon=True,
-        )
-        self._process.start()
+        from fiber_tpu.launcher import ProcessStartError
+
+        for attempt in (1, 2):
+            self._process = Process(
+                target=_run_server,
+                args=(factories, writer, self._authkey),
+                name=f"Manager-{id(self):x}",
+                daemon=True,
+            )
+            try:
+                self._process.start()
+                break
+            except ProcessStartError:
+                # Start-failure absorption (reference posture,
+                # fiber/pool.py:96-104): a transient launch failure —
+                # e.g. the admin handshake losing a race on a loaded
+                # host — is retried once before surfacing; the dead
+                # launch left no job behind (the launcher reaped it).
+                if attempt == 2:
+                    raise
+                logger.warning("manager server start failed; retrying")
+                self._process = None
         self._address = tuple(reader.recv(60))
         reader.close()
         self._control = BaseProxy(self._address, 0, "#control",

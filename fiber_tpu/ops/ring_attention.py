@@ -40,6 +40,9 @@ def _block_attn(q, k, v, mask):
 
 _compiled_cache: dict = {}
 
+#: Max kv-chunk a device materializes scores against at once (tokens).
+_KV_CHUNK = 1024
+
 
 def ring_attention_local(q_blk, k_blk, v_blk, *, axis: str,
                          n_devices: int | None = None,
@@ -70,11 +73,11 @@ def ring_attention_local(q_blk, k_blk, v_blk, *, axis: str,
     my = jax.lax.axis_index(axis)
     q_pos = my * sq + jnp.arange(sq)            # global query positions
 
-    def accumulate(k_cur, v_cur, src_dev, m, l, o):
-        kv_pos = src_dev * sq + jnp.arange(sq)  # global kv positions
+    def accumulate_chunk(q_pos_all, k_cur, v_cur, kv_pos, m, l, o):
+        """Online-softmax update of (m, l, o) with ONE kv chunk."""
         mask = None
         if causal:
-            mask = q_pos[:, None] >= kv_pos[None, :]
+            mask = q_pos_all[:, None] >= kv_pos[None, :]
         s = _block_attn(q_blk, k_cur, v_cur, mask)   # (h, sq, skv)
         m_new = jnp.maximum(m, s.max(axis=-1))
         # Guard -inf - -inf (fully masked rows) producing NaN.
@@ -89,6 +92,33 @@ def ring_attention_local(q_blk, k_blk, v_blk, *, axis: str,
         o_corr = o * corr.transpose(1, 0)[:, :, None]
         o_new = o_corr + jnp.einsum("hqk,khd->qhd", p, v_cur)
         return m_new, l_new, o_new
+
+    # Intra-block chunking: one device's kv block can itself be huge
+    # (single-chip long context: n_dev=1 means skv == S). Scanning kv
+    # chunks bounds the materialized score slab at (h, sq, chunk)
+    # instead of (h, sq, skv) — without it, 32k tokens on one chip
+    # needs tens of GB for scores. Differentiable (lax.scan) and exact:
+    # the chunk loop is the same online-softmax recurrence the ring
+    # itself uses. _KV_CHUNK divides evenly or the block stays whole.
+    def accumulate(k_cur, v_cur, src_dev, m, l, o):
+        skv = k_cur.shape[0]
+        if skv <= _KV_CHUNK or skv % _KV_CHUNK != 0:
+            kv_pos = src_dev * skv + jnp.arange(skv)
+            return accumulate_chunk(q_pos, k_cur, v_cur, kv_pos, m, l, o)
+        n_chunks = skv // _KV_CHUNK
+        k_ch = k_cur.reshape(n_chunks, _KV_CHUNK, *k_cur.shape[1:])
+        v_ch = v_cur.reshape(n_chunks, _KV_CHUNK, *v_cur.shape[1:])
+
+        def chunk_body(carry, inp):
+            m, l, o = carry
+            kc, vc, idx = inp
+            kv_pos = src_dev * skv + idx * _KV_CHUNK + jnp.arange(_KV_CHUNK)
+            m, l, o = accumulate_chunk(q_pos, kc, vc, kv_pos, m, l, o)
+            return (m, l, o), None
+
+        (m, l, o), _ = jax.lax.scan(
+            chunk_body, (m, l, o), (k_ch, v_ch, jnp.arange(n_chunks)))
+        return m, l, o
 
     m0 = jnp.full((h, sq), -jnp.inf, q_blk.dtype)
     l0 = jnp.zeros((h, sq), q_blk.dtype)

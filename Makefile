@@ -3,7 +3,7 @@
 
 PYTEST ?= python -m pytest tests/ -q
 
-.PHONY: test stest test-all lint bench
+.PHONY: test stest test-all lint bench docs
 
 # Tier 1: local backend (subprocess jobs)
 test:
@@ -24,3 +24,9 @@ bench:
 
 lint:
 	python -m compileall -q fiber_tpu examples bench.py __graft_entry__.py
+
+# Docs site (reference parity: built mkdocs site). Prefers mkdocs when
+# installed; otherwise the zero-dependency renderer (same mkdocs.yml nav).
+docs:
+	@if command -v mkdocs >/dev/null 2>&1; then mkdocs build; \
+	else python scripts/build_docs.py; fi

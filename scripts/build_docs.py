@@ -1,0 +1,195 @@
+"""Zero-dependency docs site builder.
+
+Renders the mkdocs.yml nav into a static HTML site (site/) with the
+stdlib alone; the `markdown` package is used for proper HTML when
+present (it usually is), with an escaped-<pre> fallback otherwise — so
+`make docs` succeeds in environments where mkdocs itself isn't
+installed (this repo's CI/TPU images). When mkdocs IS installed,
+`make docs` prefers it — this builder reads the same mkdocs.yml so the
+two stay in lockstep.
+
+Reference parity: the reference ships a built mkdocs-material site
+(/root/reference/mkdocs/mkdocs.yml -> docs/); this is the in-repo
+equivalent with the heavy toolchain made optional.
+
+Run:  python scripts/build_docs.py [--out site]
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import os
+import re
+import shutil
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PAGE = """<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>{title} — {site}</title>
+<style>
+:root {{ color-scheme: light dark; }}
+body {{ margin: 0; font: 16px/1.6 system-ui, sans-serif; display: flex; }}
+nav {{ min-width: 230px; max-width: 230px; padding: 1.2rem; border-right: 1px solid #8884;
+      position: sticky; top: 0; height: 100vh; overflow-y: auto; box-sizing: border-box; }}
+nav a {{ display: block; padding: .15rem 0; color: inherit; text-decoration: none; }}
+nav a.current {{ font-weight: 700; }}
+nav .section {{ margin-top: .6rem; font-weight: 600; opacity: .7; }}
+nav .sub a {{ padding-left: .8rem; }}
+main {{ padding: 1.5rem 2.5rem; max-width: 54rem; min-width: 0; }}
+pre {{ background: #8881; padding: .8rem 1rem; border-radius: 6px; overflow-x: auto; }}
+code {{ background: #8881; padding: .08rem .3rem; border-radius: 4px; font-size: .92em; }}
+pre code {{ background: none; padding: 0; }}
+table {{ border-collapse: collapse; }}
+th, td {{ border: 1px solid #8886; padding: .35rem .7rem; text-align: left; }}
+h1, h2, h3 {{ line-height: 1.25; }}
+a {{ color: #06c; }}
+</style>
+</head>
+<body>
+<nav>
+<div style="font-weight:700;margin-bottom:.5rem">{site}</div>
+{nav}
+</nav>
+<main>
+{body}
+</main>
+</body>
+</html>
+"""
+
+
+def parse_mkdocs_yml(path: str) -> dict:
+    """Minimal parser for this repo's mkdocs.yml (flat keys + the nav
+    list with one nesting level). Avoids a YAML dependency on purpose."""
+    cfg = {"nav": []}
+    stack = [cfg["nav"]]  # current nav list targets by indent
+    in_nav = False
+    section = None
+    with open(path) as fh:
+        for raw in fh:
+            line = raw.rstrip("\n")
+            if not line.strip() or line.lstrip().startswith("#"):
+                continue
+            if not line.startswith(" ") and ":" in line:
+                key, _, value = line.partition(":")
+                in_nav = key.strip() == "nav"
+                section = None
+                if not in_nav and value.strip():
+                    cfg[key.strip()] = value.strip()
+                continue
+            if not in_nav:
+                continue
+            m = re.match(r"^(\s*)-\s*(.+?):\s*(.*)$", line)
+            if not m:
+                continue
+            indent, title, target = m.groups()
+            if target:
+                entry = {"title": title.strip(), "file": target.strip()}
+                if len(indent) > 2 and section is not None:
+                    section["children"].append(entry)
+                else:
+                    cfg["nav"].append(entry)
+            else:
+                section = {"title": title.strip(), "children": []}
+                cfg["nav"].append(section)
+    return cfg
+
+
+def out_path(md_file: str) -> str:
+    return re.sub(r"\.md$", ".html", md_file)
+
+
+def render_nav(cfg: dict, current: str) -> str:
+    parts = []
+
+    def link(entry, cls=""):
+        href = os.path.relpath(out_path(entry["file"]),
+                               os.path.dirname(current) or ".")
+        cur = " class=\"current\"" if entry["file"] == current else ""
+        return f'<a href="{href}"{cur}>{html.escape(entry["title"])}</a>'
+
+    for entry in cfg["nav"]:
+        if "children" in entry:
+            parts.append(f'<div class="section">{html.escape(entry["title"])}</div>')
+            parts.append('<div class="sub">')
+            parts.extend(link(c) for c in entry["children"])
+            parts.append("</div>")
+        else:
+            parts.append(link(entry))
+    return "\n".join(parts)
+
+
+def flatten(cfg: dict):
+    for entry in cfg["nav"]:
+        if "children" in entry:
+            yield from entry["children"]
+        else:
+            yield entry
+
+
+def _make_renderer():
+    """Markdown→HTML via the `markdown` package when present; otherwise a
+    last-ditch escaped-<pre> renderer so the site always builds (pages
+    are readable, just unstyled markdown source)."""
+    try:
+        import markdown
+    except ImportError:
+        return lambda text: "<pre>{}</pre>".format(html.escape(text))
+    md = markdown.Markdown(extensions=["fenced_code", "tables", "toc"])
+    return lambda text: md.reset().convert(text)
+
+
+def build(out_dir: str) -> int:
+    cfg = parse_mkdocs_yml(os.path.join(REPO, "mkdocs.yml"))
+    docs_dir = os.path.join(REPO, cfg.get("docs_dir", "docs"))
+    site = cfg.get("site_name", "docs")
+    os.makedirs(out_dir, exist_ok=True)
+    pages = list(flatten(cfg))
+    if not pages:
+        print("no nav entries in mkdocs.yml", file=sys.stderr)
+        return 1
+    missing = [p["file"] for p in pages
+               if not os.path.exists(os.path.join(docs_dir, p["file"]))]
+    if missing:
+        print(f"nav references missing pages: {missing}", file=sys.stderr)
+        return 1
+    render = _make_renderer()
+    for page in pages:
+        src = os.path.join(docs_dir, page["file"])
+        with open(src) as fh:
+            text = fh.read()
+        # .md links keep working inside the rendered site
+        text = re.sub(r"(\]\([^)#\s]+?)\.md([#)])", r"\1.html\2", text)
+        body = render(text)
+        dest = os.path.join(out_dir, out_path(page["file"]))
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        with open(dest, "w") as fh:
+            fh.write(_PAGE.format(
+                title=html.escape(page["title"]),
+                site=html.escape(site),
+                nav=render_nav(cfg, page["file"]),
+                body=body,
+            ))
+    # index.html -> first nav page
+    first = out_path(pages[0]["file"])
+    shutil.copyfile(os.path.join(out_dir, first),
+                    os.path.join(out_dir, "index.html"))
+    print(f"built {len(pages)} pages -> {out_dir}")
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=os.path.join(REPO, "site"))
+    args = parser.parse_args()
+    return build(args.out)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

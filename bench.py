@@ -677,7 +677,41 @@ def _lm_bench(args, devices) -> int:
         "platform": devices[0].platform,
         "final_loss": float(jax.device_get(loss)),
     }
+    # Ring number recorded durably before the kernel A/B leg (a wedged
+    # Mosaic compile must not erase it).
     _record_or_attach_tpu_run(result, wedged=args.wedged_fallback)
+
+    # A/B: the same train step through the Pallas flash kernels (fwd +
+    # bwd), single device — the LM-training half of the kernel story.
+    try:
+        if devices[0].platform != "tpu" or n_dev != 1:
+            raise RuntimeError(
+                "flash LM A/B needs Mosaic and a single-device run")
+        flash_watchdog = _watchdog(args.init_timeout, dict(result))
+        try:
+            fmodel = TinyLM(vocab=vocab, dim=dim, heads=heads,
+                            layers=layers, max_seq=seq, mesh=mesh,
+                            attention="flash")
+            fstep = make_train_step(fmodel, opt)
+            fparams = fmodel.init(jax.random.PRNGKey(0))
+            fopt_state = opt.init(fparams)
+            fparams, fopt_state, floss = fstep(fparams, fopt_state, toks)
+            jax.block_until_ready(floss)
+        finally:
+            flash_watchdog.cancel()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fparams, fopt_state, floss = fstep(fparams, fopt_state, toks)
+        jax.block_until_ready(floss)
+        flash_elapsed = time.perf_counter() - t0
+        result["flash_tokens_per_sec"] = round(
+            seq * iters / flash_elapsed, 1)
+        result["flash_train_speedup"] = round(elapsed / flash_elapsed, 3)
+        result["flash_final_loss"] = float(jax.device_get(floss))
+        _record_or_attach_tpu_run(result, wedged=args.wedged_fallback)
+    except Exception as err:  # noqa: BLE001
+        result["flash_error"] = repr(err)
+
     _emit(result)
     return 0
 

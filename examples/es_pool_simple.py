@@ -35,15 +35,15 @@ def worker(args):
     return fitness(theta + sigma * epsilon), epsilon
 
 
-def es(theta0, workers, sigma, alpha, iterations, pool):
+def es(theta0, pop, sigma, alpha, iterations, pool):
     theta = theta0
     for t in range(iterations):
-        jobs = [(theta, sigma, t * workers + i) for i in range(workers)]
+        jobs = [(theta, sigma, t * pop + i) for i in range(pop)]
         returns = pool.map(worker, jobs)
         rewards = np.array([r for r, _ in returns])
         epsilons = np.stack([e for _, e in returns])
         normalized = (rewards - rewards.mean()) / (rewards.std() + 1e-8)
-        theta = theta + alpha / (workers * sigma) * normalized @ epsilons
+        theta = theta + alpha / (pop * sigma) * normalized @ epsilons
         if t % 20 == 0:
             print(f"iter {t:4d} fitness {fitness(theta):10.4f} theta {theta}")
     return theta
@@ -52,7 +52,11 @@ def es(theta0, workers, sigma, alpha, iterations, pool):
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--workers", type=int, default=4,
-                        help="pool size (and population per iteration)")
+                        help="pool processes (tasks fan out over these)")
+    parser.add_argument("--pop", type=int, default=40,
+                        help="candidates per iteration (the GECCO "
+                             "tutorial used 40 = one per worker; they "
+                             "need not match)")
     parser.add_argument("--iters", type=int, default=200)
     parser.add_argument("--sigma", type=float, default=0.1)
     parser.add_argument("--alpha", type=float, default=0.05)
@@ -62,7 +66,7 @@ def main():
 
     theta0 = np.random.default_rng(0).standard_normal(3)
     with fiber_tpu.Pool(args.workers) as pool:
-        theta = es(theta0, args.workers, args.sigma, args.alpha,
+        theta = es(theta0, args.pop, args.sigma, args.alpha,
                    args.iters, pool)
     err = float(np.linalg.norm(theta - SOLUTION))
     print(f"result {theta}  (|error| = {err:.3f})")

@@ -26,8 +26,11 @@ class TinyLM:
     """Causal byte/token LM. ``attention`` picks the plane:
     ``"ring"`` (sequence sharded via ppermute ring + online softmax),
     ``"ulysses"`` (all-to-all head/seq swap; needs
-    ``heads % n_devices == 0``), or ``"reference"`` (full score matrix,
-    single device — for parity tests).
+    ``heads % n_devices == 0``), ``"flash"`` (the Pallas
+    flash-attention kernels, forward AND backward — single device,
+    whole sequence in HBM, scores streamed through VMEM), or
+    ``"reference"`` (full score matrix, single device — for parity
+    tests).
 
     ``apply(params, tokens (S,)) -> (S, vocab)`` logits;
     ``loss(params, tokens)`` is mean next-token cross-entropy.
@@ -47,8 +50,20 @@ class TinyLM:
     ) -> None:
         if dim % heads:
             raise ValueError(f"dim {dim} not divisible by heads {heads}")
-        if attention not in ("ring", "ulysses", "reference"):
+        if attention not in ("ring", "ulysses", "flash", "reference"):
             raise ValueError(f"unknown attention {attention!r}")
+        if attention == "flash" and mesh is not None:
+            import numpy as np
+
+            if int(np.prod(list(mesh.shape.values()))) > 1:
+                # Loud, at construction: flash is the single-device
+                # plane (whole sequence on one chip, scores in VMEM);
+                # silently ignoring the mesh would look like sequence
+                # scaling and OOM at exactly the lengths ring/ulysses
+                # exist for.
+                raise ValueError(
+                    "attention='flash' is single-device; use 'ring' or "
+                    "'ulysses' to shard the sequence over a mesh")
         self.vocab = vocab
         self.dim = dim
         self.heads = heads
@@ -98,6 +113,16 @@ class TinyLM:
             from fiber_tpu.ops.ring_attention import reference_attention
 
             return reference_attention(q, k, v, causal=True)
+        if self.attention == "flash":
+            from fiber_tpu.ops.pallas_attention import (
+                flash_attention,
+                flash_available,
+            )
+
+            # Interpreter off-TPU so parity tests run anywhere; the
+            # kernel proper needs Mosaic.
+            return flash_attention(q, k, v, causal=True,
+                                   interpret=not flash_available())
         if self.attention == "ulysses":
             from fiber_tpu.ops.ulysses_attention import ulysses_attention
 

@@ -1,19 +1,21 @@
-"""Flash attention as a Pallas TPU kernel — the per-device block of the
-long-context plane.
+"""Flash attention as Pallas TPU kernels — the per-device block of the
+long-context plane, forward AND backward.
 
 Motivation (round-2 verdict: "make one kernel earn its keep"): the
 XLA-path local attention (`ring_attention._block_attn`) materializes the
 full (heads, sq, skv) score tensor in HBM per KV block — at 8k tokens
 single-chip that is gigabytes of HBM traffic, and past ~16k it simply
-does not fit. This kernel streams KV blocks through VMEM with online
+does not fit. These kernels stream KV blocks through VMEM with online
 softmax accumulators, so scores never touch HBM: O(S) memory instead of
 O(S**2), and the matmuls stay on the MXU back-to-back.
 
-Scope: forward only (the training path keeps the differentiable XLA
-implementation; differentiating through the kernel raises). Exact — not
-an approximation: output matches `reference_attention` to numerical
-tolerance, pinned by tests in interpret mode on CPU and A/B'd on chip by
-``bench.py --attention`` (``attn_flash_speedup``).
+Differentiable: ``flash_attention`` carries a ``jax.custom_vjp`` whose
+backward runs two more Pallas kernels (dq sweep over KV blocks; dk/dv
+sweep over Q blocks) from the saved (q, k, v, out, logsumexp) residuals
+— the FlashAttention-2 recurrence. Exact — not an approximation: output
+and gradients match the full-matrix reference to numerical tolerance,
+pinned by tests in interpret mode on CPU and A/B'd on chip by
+``bench.py --attention`` (``flash_speedup``).
 
 The reference framework has no kernels and no attention (SURVEY.md §5);
 this is the repo's own TPU-native bar, not a parity item.
@@ -22,20 +24,25 @@ this is the repo's own TPU-native bar, not a parity item.
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 _NEG_INF = -1e30  # large-negative instead of -inf: avoids inf-inf NaNs
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-            block_q: int, block_kv: int, n_kv: int, causal: bool,
-            scale: float):
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                acc_ref, *, block_q: int, block_kv: int, n_kv: int,
+                causal: bool, scale: float):
     """One (head, q-block, kv-block) grid step.
 
     Grid = (heads, S/block_q, S/block_kv), kv innermost: the VMEM
     scratch accumulators (m, l, acc) persist across the kv sweep of one
     (head, q-block) and are re-initialized when kv==0. At kv==n_kv-1 the
-    normalized output block is written once.
+    normalized output block and the logsumexp (the backward residual)
+    are written once.
     """
     import jax
     import jax.numpy as jnp
@@ -90,8 +97,142 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     @pl.when(ik == n_kv - 1)
     def _finalize():
         l = l_ref[:]
-        l = jnp.where(l == 0.0, 1.0, l)              # fully-masked rows
-        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        safe_l = jnp.where(l == 0.0, 1.0, l)         # fully-masked rows
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        # logsumexp residual for the backward pass: exp(s - lse) is the
+        # already-normalized softmax weight.
+        lse_ref[0] = (m_ref[:] + jnp.log(safe_l))[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# Backward (FlashAttention-2 recurrence)
+#
+#   p_ij   = exp(s_ij - lse_i)                (softmax weights, normalized)
+#   dv_j   = sum_i p_ij^T do_i
+#   dp_ij  = do_i v_j^T
+#   ds_ij  = p_ij * (dp_ij - delta_i),  delta_i = rowsum(do_i * o_i)
+#   dq_i   = scale * sum_j ds_ij k_j
+#   dk_j   = scale * sum_i ds_ij^T q_i
+# ---------------------------------------------------------------------------
+
+
+def _bwd_p_ds(q, k, v, do, lse, delta, iq, ik, *, block_q, block_kv,
+              causal, scale):
+    """Shared recompute: softmax weights p and score grads ds for one
+    (q-block, kv-block) pair, all f32."""
+    import jax
+    import jax.numpy as jnp
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    p = jnp.exp(s - lse[:, None])                    # (bq, bkv)
+    if causal:
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0)
+        kv_pos = ik * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        p = jnp.where(q_pos >= kv_pos, p, 0.0)
+    dp = jax.lax.dot_general(                        # do @ v^T
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta[:, None])
+    return p, ds
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, block_q: int, block_kv: int,
+                   n_kv: int, causal: bool, scale: float):
+    """Grid (heads, n_q, n_kv), kv innermost: accumulate dq for one
+    q-block across the KV sweep."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    if causal:
+        run = ik * block_kv < (iq + 1) * block_q
+    else:
+        run = jnp.bool_(True)
+
+    @pl.when(run)
+    def _accumulate():
+        import jax
+
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        _, ds = _bwd_p_ds(q, k, v, do, lse_ref[0], delta_ref[0], iq, ik,
+                          block_q=block_q, block_kv=block_kv,
+                          causal=causal, scale=scale)
+        dq_acc[:] += jax.lax.dot_general(            # ds @ k
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ik == n_kv - 1)
+    def _finalize():
+        dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
+                    block_kv: int, n_q: int, causal: bool, scale: float):
+    """Grid (heads, n_kv, n_q), q innermost: accumulate dk and dv for
+    one kv-block across the Q sweep."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    if causal:
+        run = ik * block_kv < (iq + 1) * block_q
+    else:
+        run = jnp.bool_(True)
+
+    @pl.when(run)
+    def _accumulate():
+        import jax
+
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        p, ds = _bwd_p_ds(q, k, v, do, lse_ref[0], delta_ref[0], iq, ik,
+                          block_q=block_q, block_kv=block_kv,
+                          causal=causal, scale=scale)
+        dv_acc[:] += jax.lax.dot_general(            # p^T @ do
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_acc[:] += jax.lax.dot_general(            # ds^T @ q
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(iq == n_q - 1)
+    def _finalize():
+        dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Builder / public API
+# ---------------------------------------------------------------------------
 
 
 def _pick_block(s: int, want: int) -> int:
@@ -110,10 +251,10 @@ def _pick_block(s: int, want: int) -> int:
 def flash_attention(q, k, v, *, causal: bool = False,
                     block_q: int = 512, block_kv: int = 512,
                     interpret: bool = False):
-    """Exact attention, O(S) memory. q, k, v: (S, heads, head_dim);
-    returns (S, heads, head_dim) in q's dtype. Forward-only.
+    """Exact attention, O(S) memory, differentiable. q, k, v:
+    (S, heads, head_dim); returns (S, heads, head_dim) in q's dtype.
 
-    ``interpret=True`` runs the kernel in the Pallas interpreter
+    ``interpret=True`` runs the kernels in the Pallas interpreter
     (CPU-testable, slow) — used by the test suite; on TPU leave False.
     The compiled program is cached per (shape, dtype, flags).
     """
@@ -132,24 +273,22 @@ def _build(shape, dtype, causal, block_q, block_kv, interpret):
     s, h, d = shape
     bq = _pick_block(s, block_q)
     bk = _pick_block(s, block_kv)
+    n_q = s // bq
     n_kv = s // bk
     scale = 1.0 / (d ** 0.5)
 
-    kernel = functools.partial(
-        _kernel, block_q=bq, block_kv=bk, n_kv=n_kv, causal=causal,
-        scale=scale,
-    )
-    grid = (h, s // bq, n_kv)
-    call = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda ih, iq, ik: (ih, iq, 0)),
-            pl.BlockSpec((1, bk, d), lambda ih, iq, ik: (ih, ik, 0)),
-            pl.BlockSpec((1, bk, d), lambda ih, iq, ik: (ih, ik, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda ih, iq, ik: (ih, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((h, s, d), dtype),
+    qkv_spec_q = pl.BlockSpec((1, bq, d), lambda ih, iq, ik: (ih, iq, 0))
+    qkv_spec_k = pl.BlockSpec((1, bk, d), lambda ih, iq, ik: (ih, ik, 0))
+    row_spec_q = pl.BlockSpec((1, bq), lambda ih, iq, ik: (ih, iq))
+
+    fwd_call = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_q=bq, block_kv=bk,
+                          n_kv=n_kv, causal=causal, scale=scale),
+        grid=(h, n_q, n_kv),
+        in_specs=[qkv_spec_q, qkv_spec_k, qkv_spec_k],
+        out_specs=[qkv_spec_q, row_spec_q],
+        out_shape=[jax.ShapeDtypeStruct((h, s, d), dtype),
+                   jax.ShapeDtypeStruct((h, s), jnp.float32)],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),    # running max m
             pltpu.VMEM((bq, 1), jnp.float32),    # denominator l
@@ -158,15 +297,64 @@ def _build(shape, dtype, causal, block_q, block_kv, interpret):
         interpret=interpret,
     )
 
-    @jax.jit
-    def run(q, k, v):
-        # (S, H, D) -> (H, S, D): heads become the outer grid dimension
-        # and each block a clean (block, d) tile.
-        out = call(jnp.swapaxes(q, 0, 1), jnp.swapaxes(k, 0, 1),
-                   jnp.swapaxes(v, 0, 1))
-        return jnp.swapaxes(out, 0, 1)
+    dq_call = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_q=bq, block_kv=bk,
+                          n_kv=n_kv, causal=causal, scale=scale),
+        grid=(h, n_q, n_kv),
+        in_specs=[qkv_spec_q, qkv_spec_k, qkv_spec_k, qkv_spec_q,
+                  row_spec_q, row_spec_q],
+        out_specs=qkv_spec_q,
+        out_shape=jax.ShapeDtypeStruct((h, s, d), dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )
 
-    return run
+    # dkv grid is (h, n_kv, n_q): program ids land as (ih, ik, iq).
+    dkv_q_spec = pl.BlockSpec((1, bq, d), lambda ih, ik, iq: (ih, iq, 0))
+    dkv_k_spec = pl.BlockSpec((1, bk, d), lambda ih, ik, iq: (ih, ik, 0))
+    dkv_row_spec = pl.BlockSpec((1, bq), lambda ih, ik, iq: (ih, iq))
+    dkv_call = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=bq, block_kv=bk,
+                          n_q=n_q, causal=causal, scale=scale),
+        grid=(h, n_kv, n_q),
+        in_specs=[dkv_q_spec, dkv_k_spec, dkv_k_spec, dkv_q_spec,
+                  dkv_row_spec, dkv_row_spec],
+        out_specs=[dkv_k_spec, dkv_k_spec],
+        out_shape=[jax.ShapeDtypeStruct((h, s, d), dtype),
+                   jax.ShapeDtypeStruct((h, s, d), dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )
+
+    def _fwd_core(q, k, v):
+        """(S,H,D) API -> (H,S,D) kernels and back."""
+        out, lse = fwd_call(jnp.swapaxes(q, 0, 1), jnp.swapaxes(k, 0, 1),
+                            jnp.swapaxes(v, 0, 1))
+        return jnp.swapaxes(out, 0, 1), lse
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        out, _ = _fwd_core(q, k, v)
+        return out
+
+    def attn_fwd(q, k, v):
+        out, lse = _fwd_core(q, k, v)
+        return out, (q, k, v, out, lse)
+
+    def attn_bwd(res, dout):
+        q, k, v, out, lse = res
+        delta = jnp.einsum(
+            "shd,shd->hs", dout.astype(jnp.float32),
+            out.astype(jnp.float32))
+        qt, kt, vt = (jnp.swapaxes(x, 0, 1) for x in (q, k, v))
+        dot = jnp.swapaxes(dout, 0, 1)
+        dq = dq_call(qt, kt, vt, dot, lse, delta)
+        dk, dv = dkv_call(qt, kt, vt, dot, lse, delta)
+        return tuple(jnp.swapaxes(g, 0, 1) for g in (dq, dk, dv))
+
+    attn.defvjp(attn_fwd, attn_bwd)
+    return jax.jit(attn)
 
 
 def flash_available() -> bool:

@@ -71,6 +71,22 @@ def test_map_elites_maze_example():
     assert "map-elites done" in out
 
 
+def test_es_pool_simple_example():
+    """Tutorial 1's host-path ES (the GECCO es.py arc): converges to the
+    hidden vector over Pool.map."""
+    out = _run("es_pool_simple.py", "--workers", "2", "--iters", "120")
+    assert "result" in out
+    assert "|error|" in out
+
+
+def test_pod_es_ring_example():
+    """Tutorial 2's capstone: Ring ranks as sim-agent cluster jobs
+    forming one multi-process JAX mesh, fused ES over it."""
+    out = _run("pod_es_ring.py", "--sim", "2", "--size", "2",
+               timeout=420)
+    assert "all ranks joined cleanly" in out
+
+
 def test_line_count_example():
     out = _run("line_count.py")
     assert "files counted" in out

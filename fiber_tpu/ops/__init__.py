@@ -26,6 +26,7 @@ from fiber_tpu.ops.map_elites import (  # noqa: F401
 )
 from fiber_tpu.ops.poet import POET  # noqa: F401
 from fiber_tpu.ops.ring_attention import (  # noqa: F401
+    blockwise_attention,
     ring_attention,
     ring_attention_local,
 )

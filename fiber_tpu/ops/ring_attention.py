@@ -44,6 +44,84 @@ _compiled_cache: dict = {}
 _KV_CHUNK = 1024
 
 
+def _accumulate_block(q_blk, q_pos, k_cur, v_cur, kv_pos0, m, l, o,
+                      causal: bool):
+    """Online-softmax update of (m, l, o) with one KV block, internally
+    chunked so the materialized score slab is bounded at
+    (h, sq, _KV_CHUNK) — shared by the ring body (per rotation) and
+    :func:`blockwise_attention` (single block = whole sequence).
+
+    q_blk: (sq, h, d); k_cur/v_cur: (skv, h, d); q_pos: (sq,) global
+    query positions; kv_pos0: scalar global position of k_cur[0].
+    m, l: (h, sq); o: (sq, h, d).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def one_chunk(k_c, v_c, kv_pos, m, l, o):
+        mask = None
+        if causal:
+            mask = q_pos[:, None] >= kv_pos[None, :]
+        s = _block_attn(q_blk, k_c, v_c, mask)       # (h, sq, skv)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # Guard -inf - -inf (fully masked rows) producing NaN.
+        m_safe = jnp.where(jnp.isinf(m_new), 0.0, m_new)
+        p = jnp.exp(s - m_safe[..., None])
+        if mask is not None:
+            p = jnp.where(mask[None, :, :], p, 0.0)
+        corr = jnp.where(
+            jnp.isinf(m), 0.0, jnp.exp(m - m_safe)
+        )                                            # (h, sq)
+        l_new = l * corr + p.sum(axis=-1)
+        o_corr = o * corr.transpose(1, 0)[:, :, None]
+        o_new = o_corr + jnp.einsum("hqk,khd->qhd", p, v_c)
+        return m_new, l_new, o_new
+
+    skv = k_cur.shape[0]
+    if skv <= _KV_CHUNK:
+        kv_pos = kv_pos0 + jnp.arange(skv)
+        return one_chunk(k_cur, v_cur, kv_pos, m, l, o)
+    # Divisible prefix via scan; any remainder as one short tail chunk —
+    # the O(sq x _KV_CHUNK) score bound must hold for ARBITRARY skv,
+    # not just multiples (a 33k-token call must never silently fall
+    # back to the full slab).
+    n_chunks = skv // _KV_CHUNK
+    main = n_chunks * _KV_CHUNK
+    k_ch = k_cur[:main].reshape(n_chunks, _KV_CHUNK, *k_cur.shape[1:])
+    v_ch = v_cur[:main].reshape(n_chunks, _KV_CHUNK, *v_cur.shape[1:])
+
+    def chunk_body(carry, inp):
+        m, l, o = carry
+        kc, vc, idx = inp
+        kv_pos = kv_pos0 + idx * _KV_CHUNK + jnp.arange(_KV_CHUNK)
+        return one_chunk(kc, vc, kv_pos, m, l, o), None
+
+    (m, l, o), _ = jax.lax.scan(
+        chunk_body, (m, l, o), (k_ch, v_ch, jnp.arange(n_chunks)))
+    if skv > main:
+        kv_pos = kv_pos0 + main + jnp.arange(skv - main)
+        m, l, o = one_chunk(k_cur[main:], v_cur[main:], kv_pos, m, l, o)
+    return m, l, o
+
+
+def blockwise_attention(q, k, v, causal: bool = False):
+    """Exact single-device attention with the score slab bounded at
+    (h, sq, _KV_CHUNK) — the memory-safe local plane for long context
+    without a kernel (differentiable everywhere; on TPU the Pallas
+    :func:`fiber_tpu.ops.pallas_attention.flash_attention` is the
+    faster equivalent). q, k, v: (S, heads, head_dim)."""
+    import jax.numpy as jnp
+
+    sq, h, _ = q.shape
+    q_pos = jnp.arange(sq)
+    m0 = jnp.full((h, sq), -jnp.inf, q.dtype)
+    l0 = jnp.zeros((h, sq), q.dtype)
+    o0 = jnp.zeros_like(q)
+    m, l, o = _accumulate_block(q, q_pos, k, v, 0, m0, l0, o0, causal)
+    l = jnp.where(l == 0.0, 1.0, l)
+    return o / l.transpose(1, 0)[:, :, None]
+
+
 def ring_attention_local(q_blk, k_blk, v_blk, *, axis: str,
                          n_devices: int | None = None,
                          causal: bool = False):
@@ -73,52 +151,18 @@ def ring_attention_local(q_blk, k_blk, v_blk, *, axis: str,
     my = jax.lax.axis_index(axis)
     q_pos = my * sq + jnp.arange(sq)            # global query positions
 
-    def accumulate_chunk(q_pos_all, k_cur, v_cur, kv_pos, m, l, o):
-        """Online-softmax update of (m, l, o) with ONE kv chunk."""
-        mask = None
-        if causal:
-            mask = q_pos_all[:, None] >= kv_pos[None, :]
-        s = _block_attn(q_blk, k_cur, v_cur, mask)   # (h, sq, skv)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        # Guard -inf - -inf (fully masked rows) producing NaN.
-        m_safe = jnp.where(jnp.isinf(m_new), 0.0, m_new)
-        p = jnp.exp(s - m_safe[..., None])
-        if mask is not None:
-            p = jnp.where(mask[None, :, :], p, 0.0)
-        corr = jnp.where(
-            jnp.isinf(m), 0.0, jnp.exp(m - m_safe)
-        )                                            # (h, sq)
-        l_new = l * corr + p.sum(axis=-1)
-        o_corr = o * corr.transpose(1, 0)[:, :, None]
-        o_new = o_corr + jnp.einsum("hqk,khd->qhd", p, v_cur)
-        return m_new, l_new, o_new
-
-    # Intra-block chunking: one device's kv block can itself be huge
-    # (single-chip long context: n_dev=1 means skv == S). Scanning kv
-    # chunks bounds the materialized score slab at (h, sq, chunk)
-    # instead of (h, sq, skv) — without it, 32k tokens on one chip
-    # needs tens of GB for scores. Differentiable (lax.scan) and exact:
-    # the chunk loop is the same online-softmax recurrence the ring
-    # itself uses. _KV_CHUNK divides evenly or the block stays whole.
+    # Per rotation, the KV block is accumulated via the shared
+    # intra-block-chunked recurrence (_accumulate_block): one device's
+    # kv block can itself be huge (single-chip long context: n_dev=1
+    # means skv == S), and chunking bounds the materialized score slab
+    # at (h, sq, _KV_CHUNK) instead of (h, sq, skv) — without it, 32k
+    # tokens on one chip needs tens of GB for scores. Differentiable
+    # and exact: the chunk loop is the same online-softmax recurrence
+    # the ring itself uses.
     def accumulate(k_cur, v_cur, src_dev, m, l, o):
-        skv = k_cur.shape[0]
-        if skv <= _KV_CHUNK or skv % _KV_CHUNK != 0:
-            kv_pos = src_dev * skv + jnp.arange(skv)
-            return accumulate_chunk(q_pos, k_cur, v_cur, kv_pos, m, l, o)
-        n_chunks = skv // _KV_CHUNK
-        k_ch = k_cur.reshape(n_chunks, _KV_CHUNK, *k_cur.shape[1:])
-        v_ch = v_cur.reshape(n_chunks, _KV_CHUNK, *v_cur.shape[1:])
-
-        def chunk_body(carry, inp):
-            m, l, o = carry
-            kc, vc, idx = inp
-            kv_pos = src_dev * skv + idx * _KV_CHUNK + jnp.arange(_KV_CHUNK)
-            m, l, o = accumulate_chunk(q_pos, kc, vc, kv_pos, m, l, o)
-            return (m, l, o), None
-
-        (m, l, o), _ = jax.lax.scan(
-            chunk_body, (m, l, o), (k_ch, v_ch, jnp.arange(n_chunks)))
-        return m, l, o
+        return _accumulate_block(q_blk, q_pos, k_cur, v_cur,
+                                 src_dev * k_cur.shape[0], m, l, o,
+                                 causal)
 
     m0 = jnp.full((h, sq), -jnp.inf, q_blk.dtype)
     l0 = jnp.zeros((h, sq), q_blk.dtype)

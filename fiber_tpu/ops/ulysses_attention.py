@@ -33,17 +33,30 @@ _compiled_cache: dict = {}
 
 
 def ulysses_attention_local(q_blk, k_blk, v_blk, *, axis: str,
-                            causal: bool = False):
+                            causal: bool = False,
+                            local: str = "reference"):
     """The raw per-device Ulysses body, for COMPOSITION inside a
     caller's own ``shard_map`` (the all-to-alls bind by axis NAME, so
     it composes with other mesh axes exactly like
     :func:`fiber_tpu.ops.ring_attention_local` — e.g. a
     ("data", "seq") 2-D mesh with the body vmapped over the local
     batch shard). Shards are (seq/n, heads, head_dim);
-    ``heads % axis_size == 0`` required."""
+    ``heads % axis_size == 0`` required.
+
+    ``local`` picks the per-device attention over the gathered
+    sequence: ``"reference"`` (full score matrix — fastest at moderate
+    seq, O(S^2) memory), ``"blockwise"`` (KV-chunked online softmax —
+    O(S·chunk) memory, differentiable everywhere), or ``"flash"``
+    (the Pallas kernels — TPU, forward+backward)."""
     import jax
 
-    from fiber_tpu.ops.ring_attention import reference_attention
+    from fiber_tpu.ops.ring_attention import (
+        blockwise_attention,
+        reference_attention,
+    )
+
+    if local not in ("reference", "blockwise", "flash"):
+        raise ValueError(f"unknown local attention {local!r}")
 
     # all-to-all #1: scatter heads, gather sequence ->
     # (seq, heads/n, head_dim); every device now sees the whole
@@ -56,7 +69,20 @@ def ulysses_attention_local(q_blk, k_blk, v_blk, *, axis: str,
     qh = seq_to_heads(q_blk)
     kh = seq_to_heads(k_blk)
     vh = seq_to_heads(v_blk)
-    out = reference_attention(qh, kh, vh, causal=causal)
+    if local == "flash":
+        from fiber_tpu.ops.pallas_attention import (
+            flash_attention,
+            flash_available,
+        )
+
+        # Interpreter off-TPU so the composed path is pinnable by the
+        # CPU suite; the kernel proper needs Mosaic.
+        out = flash_attention(qh, kh, vh, causal=causal,
+                              interpret=not flash_available())
+    elif local == "blockwise":
+        out = blockwise_attention(qh, kh, vh, causal=causal)
+    else:
+        out = reference_attention(qh, kh, vh, causal=causal)
     # all-to-all #2: scatter sequence, gather heads — back to the
     # input layout.
     return jax.lax.all_to_all(
@@ -64,7 +90,7 @@ def ulysses_attention_local(q_blk, k_blk, v_blk, *, axis: str,
     )
 
 
-def _build(mesh, axis: str, causal: bool):
+def _build(mesh, axis: str, causal: bool, local: str):
     import functools
 
     import jax
@@ -72,7 +98,7 @@ def _build(mesh, axis: str, causal: bool):
     from jax.sharding import PartitionSpec as P
 
     local_fn = functools.partial(
-        ulysses_attention_local, axis=axis, causal=causal
+        ulysses_attention_local, axis=axis, causal=causal, local=local
     )
 
     spec = P(axis)
@@ -84,13 +110,16 @@ def _build(mesh, axis: str, causal: bool):
 
 
 def ulysses_attention(q, k, v, mesh=None, axis: str = "pool",
-                      causal: bool = False):
+                      causal: bool = False, local: str = "reference"):
     """Exact attention with the sequence dim sharded over ``axis``.
 
     q, k, v: (seq, heads, head_dim); ``seq`` and ``heads`` must both
     divide evenly by the mesh axis size. Returns (seq, heads, head_dim)
-    with the same sharding. Mesh keys hash by value, so the compiled
-    program is shared across equal meshes (no id-aliasing)."""
+    with the same sharding. ``local`` picks the per-device attention
+    (see :func:`ulysses_attention_local`) — ``"blockwise"`` or
+    ``"flash"`` lift the O(S^2) local-memory constraint. Mesh keys hash
+    by value, so the compiled program is shared across equal meshes
+    (no id-aliasing)."""
     from fiber_tpu.parallel.mesh import default_mesh
 
     mesh = mesh or default_mesh()
@@ -105,9 +134,9 @@ def ulysses_attention(q, k, v, mesh=None, axis: str = "pool",
             f"ulysses needs heads % n_dev == 0 (got {heads} heads over "
             f"{n_dev} devices); use ring_attention for odd head counts"
         )
-    key = (mesh, axis, causal)
+    key = (mesh, axis, causal, local)
     fn = _compiled_cache.get(key)
     if fn is None:
-        fn = _build(mesh, axis, causal)
+        fn = _build(mesh, axis, causal, local)
         _compiled_cache[key] = fn
     return fn(q, k, v)

@@ -113,9 +113,12 @@ def harvest() -> None:
         ("attention bench",
          [sys.executable, "bench.py", "--attention", "--seq", "32768"],
          1500, None),
+        ("attention bench (long, flash A/B rides along)",
+         [sys.executable, "bench.py", "--attention", "--seq", "65536"],
+         2400, None),
         ("lm train bench",
          [sys.executable, "bench.py", "--lm", "--seq", "8192"],
-         1500, None),
+         2400, None),
     ]
     for name, cmd, timeout, env in steps:
         if cmd is None:

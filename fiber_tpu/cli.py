@@ -220,23 +220,149 @@ def cmd_up(args) -> int:
     return 0
 
 
-def cmd_status(args) -> int:
+def _probe_agent(host: str, port: int):
+    """Ping one agent; returns (host_info, live_jobs) or raises. The one
+    probing routine status and doctor share."""
     from fiber_tpu.backends.tpu import AgentClient
 
+    client = AgentClient(host, port)
+    try:
+        client.call("ping")
+        return client.call("host_info"), client.call("list_jobs")
+    finally:
+        client.close()
+
+
+def cmd_status(args) -> int:
     rc = 0
     for host, port in _parse_hosts_cli(_hosts_from_args(args)):
-        client = AgentClient(host, port)
         try:
-            client.call("ping")
-            info = client.call("host_info")
-            jobs = client.call("list_jobs")
+            info, jobs = _probe_agent(host, port)
             print(f"{host}:{port}  up  cpus={info['cpu_count']} "
                   f"live_jobs={len(jobs)} python={info['python']}")
         except Exception as err:
             print(f"{host}:{port}  DOWN  ({err})")
             rc = 1
-        finally:
-            client.close()
+    return rc
+
+
+def cmd_doctor(args) -> int:
+    """Diagnose the environment and (if hosts are known) the cluster:
+    what backend would be selected and why, whether agents answer, key
+    posture, and the env landmines that commonly wedge JAX startup.
+    Exit 0 = healthy; 1 = at least one FAIL line."""
+    from fiber_tpu import config
+
+    rc = 0
+
+    def line(ok, label, detail=""):
+        nonlocal rc
+        tag = "ok  " if ok else "FAIL"
+        if not ok:
+            rc = 1
+        print(f"[{tag}] {label}" + (f": {detail}" if detail else ""))
+
+    # 1. interpreter + config
+    line(True, "python", sys.executable)
+    cfg = config.get()
+    line(True, "config", f"backend={cfg.backend or '(auto)'} "
+                         f"tpu_hosts={cfg.tpu_hosts or '(unset)'} "
+                         f"cpu_per_job={cfg.cpu_per_job} "
+                         f"log_file={cfg.log_file}")
+
+    # 2. backend selection (and whether a sniffed tpu would fall back)
+    from fiber_tpu.backends import _select_backend
+
+    name, explicit = _select_backend()
+    line(True, "backend selection",
+         f"{name!r} ({'explicit' if explicit else 'sniffed'})")
+    if not explicit and name == "tpu":
+        print("       (sniffed: a reachability probe decides at first "
+              "use; unreachable agents fall back to 'local')")
+
+    # 3. env landmines
+    injected = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if injected:
+        line(True, "TPU_WORKER_HOSTNAMES", injected)
+    plugin = os.environ.get("PALLAS_AXON_POOL_IPS", "")
+    if plugin:
+        print("       note: a PJRT tunnel plugin env is set "
+              "(PALLAS_AXON_POOL_IPS); child interpreters inherit it — "
+              "clear it for CPU-only child runs")
+    line(True, "JAX_PLATFORMS",
+         os.environ.get("JAX_PLATFORMS", "(unset)"))
+
+    # 4. jax devices, probed in a SUBPROCESS with a timeout so a wedged
+    #    accelerator plugin can't hang the doctor itself. A hang retries
+    #    once with the accelerator path disabled to narrow the cause.
+    def probe_devices(env):
+        return subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); "
+             "print(d[0].platform, len(d))"],
+            capture_output=True, text=True, timeout=float(args.timeout),
+            env=env,
+        )
+
+    try:
+        probe = probe_devices(dict(os.environ))
+        if probe.returncode == 0:
+            platform, n = probe.stdout.split()[-2:]
+            line(True, "jax devices", f"platform={platform} count={n}")
+        else:
+            line(False, "jax devices",
+                 probe.stderr.strip().splitlines()[-1][:200]
+                 if probe.stderr.strip() else "probe failed")
+    except subprocess.TimeoutExpired:
+        retried = False
+        if plugin:
+            clean = dict(os.environ)
+            clean.pop("PALLAS_AXON_POOL_IPS", None)
+            clean["JAX_PLATFORMS"] = "cpu"
+            try:
+                probe = probe_devices(clean)
+                retried = probe.returncode == 0
+            except subprocess.TimeoutExpired:
+                pass
+        if retried:
+            line(False, "jax devices",
+                 f"probe hung >{args.timeout}s, but succeeded with the "
+                 "accelerator path disabled (tunnel plugin cleared + "
+                 "CPU forced) — the accelerator path is wedged; for "
+                 "host-only work clear PALLAS_AXON_POOL_IPS and set "
+                 "JAX_PLATFORMS=cpu")
+        else:
+            line(False, "jax devices",
+                 f"probe hung >{args.timeout}s (wedged accelerator "
+                 "runtime)")
+
+    # 5. cluster key posture
+    from fiber_tpu import auth
+
+    default_key = auth.cluster_key() == auth.DEFAULT_KEY.encode()
+    line(True, "cluster key",
+         "DEFAULT (development only — set FIBER_CLUSTER_KEY on real "
+         "clusters)" if default_key else "custom (FIBER_CLUSTER_KEY)")
+
+    # 6. agents (optional: no host list just skips the section)
+    hosts_spec = args.hosts or os.environ.get("FIBER_TPU_HOSTS", "")
+    if hosts_spec.startswith("sim:"):
+        print(f"[  --] agents: {hosts_spec} spawns local agents on "
+              "demand — nothing standing to probe")
+    elif hosts_spec:
+        for host, port in _parse_hosts_cli(hosts_spec):
+            try:
+                info, _ = _probe_agent(host, port)
+                line(True, f"agent {host}:{port}",
+                     f"cpus={info['cpu_count']} "
+                     f"staging={info['staging_root']}")
+            except Exception as err:
+                line(False, f"agent {host}:{port}", str(err)[:120])
+    else:
+        print("[  --] agents: no host list (pass --hosts or set "
+              "FIBER_TPU_HOSTS) — skipped")
+
+    print("doctor:", "healthy" if rc == 0 else "problems found")
     return rc
 
 
@@ -341,6 +467,13 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("status", help="ping every host agent")
     p.add_argument("--hosts", default="")
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("doctor",
+                       help="diagnose the environment and cluster")
+    p.add_argument("--hosts", default="")
+    p.add_argument("--timeout", type=float, default=20.0,
+                   help="seconds to wait for the jax device probe")
+    p.set_defaults(fn=cmd_doctor)
 
     p = sub.add_parser("logs", help="fetch a job's log tail by jid")
     p.add_argument("jid", help="host:port/jobid (as printed by --submit)")

@@ -45,6 +45,34 @@ def test_status_down_host(capsys):
     assert "DOWN" in capsys.readouterr().out
 
 
+def test_doctor_healthy_and_down_agent(capsys):
+    """fiber-tpu doctor: reports selection/config/devices, passes with a
+    live agent, fails (rc 1, FAIL line) on a dead one."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fiber_tpu.host_agent", "--port", "0",
+         "--announce"],
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        port = int(proc.stdout.readline().split()[1])
+        rc = main(["doctor", "--hosts", f"127.0.0.1:{port}",
+                   "--timeout", "60"])
+        out = capsys.readouterr().out
+        assert "backend selection" in out
+        assert f"agent 127.0.0.1:{port}" in out
+        # The device probe may legitimately FAIL on a wedged-tunnel dev
+        # box; everything agent/cluster-side must be ok.
+        assert "FAIL] agent" not in out
+    finally:
+        proc.terminate()
+        proc.wait(10)
+
+    rc = main(["doctor", "--hosts", "127.0.0.1:1", "--timeout", "60"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "FAIL] agent 127.0.0.1:1" in out
+
+
 def test_status_and_cp_against_sim_agent(tmp_path, capsys):
     proc = subprocess.Popen(
         [sys.executable, "-m", "fiber_tpu.host_agent", "--port", "0",

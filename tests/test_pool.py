@@ -169,6 +169,75 @@ def test_maxtasksperchild_with_packing():
         fiber_tpu.init(cpu_per_job=1)
 
 
+def test_worker_start_escalation(monkeypatch):
+    """A backend that refuses EVERY worker start while work is pending
+    must fail the map loudly (round-2 verdict: the old behavior retried
+    a permanently-refused spawn forever — the tier-2 hang). The error is
+    catchable by type AND reaches error_callback off the submit thread;
+    transient failures below the streak limit stay absorbed."""
+    import threading
+
+    from fiber_tpu import pool as poolmod
+    from fiber_tpu.backends import get_backend
+    from fiber_tpu.pool import WorkerStartError
+
+    monkeypatch.setattr(poolmod, "_SPAWN_FAIL_LIMIT", 3)
+    backend = get_backend()
+    orig = backend.create_job
+
+    def refuse(spec):
+        raise RuntimeError("injected: no capacity")
+
+    monkeypatch.setattr(backend, "create_job", refuse)
+    fired = {}
+    done = threading.Event()
+
+    def on_err(exc):
+        fired["exc"] = exc
+        fired["thread"] = threading.current_thread().name
+        done.set()
+
+    pool = fiber_tpu.Pool(2)
+    try:
+        res = pool.map_async(targets.square, range(4),
+                             error_callback=on_err)
+        with pytest.raises(WorkerStartError, match="consecutive"):
+            res.get(60)
+        assert done.wait(30)
+        assert isinstance(fired["exc"], WorkerStartError)
+        assert fired["thread"] != threading.current_thread().name
+    finally:
+        monkeypatch.setattr(backend, "create_job", orig)
+        pool.terminate()
+        pool.join()
+
+
+def test_worker_start_transient_failures_absorbed(monkeypatch):
+    """The reference's fault-injection contract (TimeoutBackend-style:
+    first N create_job calls raise, then succeed — reference
+    tests/test_process.py:27-39): the map still completes."""
+    from fiber_tpu.backends import get_backend
+
+    backend = get_backend()
+    orig = backend.create_job
+    calls = {"n": 0}
+
+    def flaky(spec):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise RuntimeError("injected transient failure")
+        return orig(spec)
+
+    monkeypatch.setattr(backend, "create_job", flaky)
+    try:
+        with fiber_tpu.Pool(2) as pool:
+            assert pool.map(targets.square, range(12)) == [
+                i * i for i in range(12)]
+    finally:
+        monkeypatch.setattr(backend, "create_job", orig)
+    assert calls["n"] > 2
+
+
 def test_non_resilient_pool():
     with fiber_tpu.Pool(2, error_handling=False) as pool:
         assert pool.map(targets.square, range(20)) == [

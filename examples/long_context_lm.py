@@ -14,7 +14,11 @@ attending ~S/2 tokens back. Watch the half2 loss dive under the half1
 (unpredictable) loss as the induction circuit forms.
 
 Run:  python examples/long_context_lm.py [--seq 512] [--steps 300]
-      [--attention ring|ulysses]
+      [--attention ring|ulysses|flash]
+
+``--attention flash`` trains through the Pallas flash-attention
+kernels instead (single device, whole sequence in HBM, scores streamed
+through VMEM — the kernel path `bench.py --lm` A/Bs on chip).
 """
 
 import os as _os
@@ -36,13 +40,13 @@ def main():
     parser.add_argument("--dim", type=int, default=128)
     parser.add_argument("--layers", type=int, default=2)
     parser.add_argument("--attention", default="ring",
-                        choices=("ring", "ulysses"))
+                        choices=("ring", "ulysses", "flash"))
     parser.add_argument("--lr", type=float, default=1e-3)
     args = parser.parse_args()
 
     import jax
 
-    n_dev_check = len(jax.devices())
+    n_dev_check = 1 if args.attention == "flash" else len(jax.devices())
     if args.seq % 2 or args.seq % n_dev_check:
         parser.error(
             f"--seq must be even (copy task halves) and divisible by "
@@ -78,9 +82,10 @@ def main():
         return l1.mean(), l2.mean()
 
     key = jax.random.PRNGKey(1)
-    n_dev = len(jax.devices())
-    print(f"{args.attention} attention, seq {args.seq} over {n_dev} "
-          f"devices ({args.seq // n_dev} tokens/device)")
+    n_dev = 1 if args.attention == "flash" else len(jax.devices())
+    plane = ("single device, kernels" if args.attention == "flash"
+             else f"{n_dev} devices ({args.seq // n_dev} tokens/device)")
+    print(f"{args.attention} attention, seq {args.seq} over {plane}")
     for i in range(args.steps):
         key, k = jax.random.split(key)
         tokens = make_batch(k)

@@ -314,27 +314,27 @@ def cmd_doctor(args) -> int:
                  probe.stderr.strip().splitlines()[-1][:200]
                  if probe.stderr.strip() else "probe failed")
     except subprocess.TimeoutExpired:
+        clean = dict(os.environ)
+        clean.pop("PALLAS_AXON_POOL_IPS", None)
+        clean["JAX_PLATFORMS"] = "cpu"
         retried = False
-        if plugin:
-            clean = dict(os.environ)
-            clean.pop("PALLAS_AXON_POOL_IPS", None)
-            clean["JAX_PLATFORMS"] = "cpu"
-            try:
-                probe = probe_devices(clean)
-                retried = probe.returncode == 0
-            except subprocess.TimeoutExpired:
-                pass
+        try:
+            probe = probe_devices(clean)
+            retried = probe.returncode == 0
+        except subprocess.TimeoutExpired:
+            pass
         if retried:
+            hint = ("clear PALLAS_AXON_POOL_IPS and set "
+                    "JAX_PLATFORMS=cpu" if plugin
+                    else "set JAX_PLATFORMS=cpu")
             line(False, "jax devices",
                  f"probe hung >{args.timeout}s, but succeeded with the "
-                 "accelerator path disabled (tunnel plugin cleared + "
-                 "CPU forced) — the accelerator path is wedged; for "
-                 "host-only work clear PALLAS_AXON_POOL_IPS and set "
-                 "JAX_PLATFORMS=cpu")
+                 "accelerator path disabled — the accelerator runtime "
+                 f"is wedged; for host-only work {hint}")
         else:
             line(False, "jax devices",
-                 f"probe hung >{args.timeout}s (wedged accelerator "
-                 "runtime)")
+                 f"probe hung >{args.timeout}s even with the "
+                 "accelerator path disabled (broken jax install?)")
 
     # 5. cluster key posture
     from fiber_tpu import auth

@@ -35,10 +35,23 @@ def main() -> int:
 
     import jax
 
-    # Pin the platform BEFORE anything initializes a backend
-    # (jax.default_backend() would cache it): cpu unless the caller
-    # asked for an accelerator via JAX_PLATFORMS.
-    platform = os.environ.get("JAX_PLATFORMS", "") or "cpu"
+    # Pin the platform AND the virtual device count BEFORE anything
+    # initializes a backend (jax.default_backend() would cache it):
+    # cpu with the 8-device plane the checked-in records were measured
+    # on, unless the caller asked for an accelerator via JAX_PLATFORMS.
+    # Per-device RNG folds and the gather topology depend on the device
+    # count, so reproduction requires the same plane.
+    # Only an explicit cpu/tpu request is honored; ambient plugin
+    # platforms (e.g. a tunnel's JAX_PLATFORMS=axon) fall back to cpu.
+    platform = os.environ.get("JAX_PLATFORMS", "")
+    if platform not in ("cpu", "tpu"):
+        platform = "cpu"
+    if platform == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     try:
         jax.config.update("jax_platforms", platform)
     except Exception:

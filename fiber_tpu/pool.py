@@ -617,8 +617,18 @@ def _pool_worker_core(
     else:
         # prefetch=2: the transport pulls the next chunk while the
         # current one computes (one parked frame at most — the plain
-        # pool has no resubmission, so the bound stays tight).
-        task_ep = connect_transport("r", task_addr, prefetch=2)
+        # pool has no resubmission, so the bound stays tight). With
+        # maxtasksperchild the window must collapse to pure demand
+        # (prefetch=1): a standing window parks one granted chunk in
+        # the inbox of a worker that breaks at its task budget, and
+        # the plain pool has no pending table to resubmit it — the
+        # chunk would be silently lost and map() would hang (advisor,
+        # round 3). prefetch=1 grants credit only to a reader blocked
+        # in recv(), so a recycle break strands nothing.
+        task_ep = connect_transport(
+            "r", task_addr,
+            prefetch=1 if maxtasksperchild else 2,
+        )
 
     completed_chunks = 0
     reason = "error"

@@ -245,6 +245,21 @@ def test_non_resilient_pool():
         ]
 
 
+def test_non_resilient_maxtasksperchild_no_lost_chunks():
+    """Regression (advisor, round 3): the plain pool's prefetch=2 window
+    parked one granted chunk in the inbox of a worker that broke at its
+    maxtasksperchild budget; with no pending table to resubmit it, the
+    chunk was silently lost and map() hung forever. The worker must
+    collapse to pure demand-driven credit (prefetch=1) when a task
+    budget is set, so every chunk handed out is either computed or
+    still held by the master."""
+    with fiber_tpu.Pool(
+        2, error_handling=False, maxtasksperchild=2
+    ) as pool:
+        res = pool.map_async(targets.square, range(40), chunksize=1)
+        assert res.get(timeout=90) == [i * i for i in range(40)]
+
+
 def test_pool_rejects_conflicting_meta():
     from fiber_tpu.meta import meta
 

@@ -23,16 +23,30 @@ from __future__ import annotations
 from typing import Optional
 
 
-def _block_attn(q, k, v, mask):
+def _acc_dtype(dtype):
+    """Softmax-statistic dtype: at least f32 (advisor, round 3: in-dtype
+    accumulators let the bf16 denominator degrade in 8 mantissa bits at
+    long context), but never narrower than the input — f64 inputs keep
+    f64 statistics (``preferred_element_type`` rejects narrowing)."""
+    import jax.numpy as jnp
+
+    return jnp.promote_types(dtype, jnp.float32)
+
+
+def _block_attn(q, k, mask):
     """Scores for one (query-block, kv-block) pair.
 
-    q: (sq, h, d)   k, v: (skv, h, d)   mask: (sq, skv) or None
-    returns s: (h, sq, skv)
+    q: (sq, h, d)   k: (skv, h, d)   mask: (sq, skv) or None
+    returns s: (h, sq, skv) in the accumulator dtype (>= f32) — the QK
+    matmul still runs on the MXU in the input dtype but accumulates
+    wide, and every downstream softmax statistic stays wide.
     """
     import jax.numpy as jnp
 
+    acc = _acc_dtype(q.dtype)
     d = q.shape[-1]
-    s = jnp.einsum("qhd,khd->hqk", q, k) / jnp.sqrt(jnp.asarray(d, q.dtype))
+    s = jnp.einsum("qhd,khd->hqk", q, k, preferred_element_type=acc)
+    s = s / jnp.sqrt(jnp.asarray(d, acc))
     if mask is not None:
         s = jnp.where(mask[None, :, :], s, jnp.finfo(s.dtype).min)
     return s
@@ -53,28 +67,37 @@ def _accumulate_block(q_blk, q_pos, k_cur, v_cur, kv_pos0, m, l, o,
 
     q_blk: (sq, h, d); k_cur/v_cur: (skv, h, d); q_pos: (sq,) global
     query positions; kv_pos0: scalar global position of k_cur[0].
-    m, l: (h, sq); o: (sq, h, d).
+    m, l: (h, sq); o: (sq, h, d) — all in ``_acc_dtype`` (>= f32),
+    allocated by :func:`_acc_init`: with bf16 inputs the denominator l
+    sums tens of thousands of terms, which 8 mantissa bits cannot carry
+    (the Pallas kernel accumulates f32 for the same reason). The p·V
+    matmul runs in the value dtype on the MXU but accumulates wide.
     """
     import jax
     import jax.numpy as jnp
+
+    acc = _acc_dtype(q_blk.dtype)
 
     def one_chunk(k_c, v_c, kv_pos, m, l, o):
         mask = None
         if causal:
             mask = q_pos[:, None] >= kv_pos[None, :]
-        s = _block_attn(q_blk, k_c, v_c, mask)       # (h, sq, skv)
+        s = _block_attn(q_blk, k_c, mask)            # (h, sq, skv) wide
         m_new = jnp.maximum(m, s.max(axis=-1))
         # Guard -inf - -inf (fully masked rows) producing NaN.
         m_safe = jnp.where(jnp.isinf(m_new), 0.0, m_new)
-        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.exp(s - m_safe[..., None])           # wide
         if mask is not None:
             p = jnp.where(mask[None, :, :], p, 0.0)
         corr = jnp.where(
             jnp.isinf(m), 0.0, jnp.exp(m - m_safe)
-        )                                            # (h, sq)
+        )                                            # (h, sq) wide
         l_new = l * corr + p.sum(axis=-1)
         o_corr = o * corr.transpose(1, 0)[:, :, None]
-        o_new = o_corr + jnp.einsum("hqk,khd->qhd", p, v_c)
+        o_new = o_corr + jnp.einsum(
+            "hqk,khd->qhd", p.astype(v_c.dtype), v_c,
+            preferred_element_type=acc,
+        )
         return m_new, l_new, o_new
 
     skv = k_cur.shape[0]
@@ -104,6 +127,28 @@ def _accumulate_block(q_blk, q_pos, k_cur, v_cur, kv_pos0, m, l, o,
     return m, l, o
 
 
+def _acc_init(q):
+    """Fresh (m, l, o) online-softmax accumulators for a (sq, h, d)
+    query block, in the wide statistic dtype."""
+    import jax.numpy as jnp
+
+    sq, h, _ = q.shape
+    acc = _acc_dtype(q.dtype)
+    m0 = jnp.full((h, sq), -jnp.inf, acc)
+    l0 = jnp.zeros((h, sq), acc)
+    o0 = jnp.zeros(q.shape, acc)
+    return m0, l0, o0
+
+
+def _acc_finalize(o, l, out_dtype):
+    """o / l with fully-masked rows (l == 0) left as zeros, cast back to
+    the caller-visible dtype."""
+    import jax.numpy as jnp
+
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (o / l.transpose(1, 0)[:, :, None]).astype(out_dtype)
+
+
 def blockwise_attention(q, k, v, causal: bool = False):
     """Exact single-device attention with the score slab bounded at
     (h, sq, _KV_CHUNK) — the memory-safe local plane for long context
@@ -112,14 +157,11 @@ def blockwise_attention(q, k, v, causal: bool = False):
     faster equivalent). q, k, v: (S, heads, head_dim)."""
     import jax.numpy as jnp
 
-    sq, h, _ = q.shape
+    sq = q.shape[0]
     q_pos = jnp.arange(sq)
-    m0 = jnp.full((h, sq), -jnp.inf, q.dtype)
-    l0 = jnp.zeros((h, sq), q.dtype)
-    o0 = jnp.zeros_like(q)
+    m0, l0, o0 = _acc_init(q)
     m, l, o = _accumulate_block(q, q_pos, k, v, 0, m0, l0, o0, causal)
-    l = jnp.where(l == 0.0, 1.0, l)
-    return o / l.transpose(1, 0)[:, :, None]
+    return _acc_finalize(o, l, q.dtype)
 
 
 def ring_attention_local(q_blk, k_blk, v_blk, *, axis: str,
@@ -147,7 +189,6 @@ def ring_attention_local(q_blk, k_blk, v_blk, *, axis: str,
              else n_devices)
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
     sq = q_blk.shape[0]
-    h = q_blk.shape[1]
     my = jax.lax.axis_index(axis)
     q_pos = my * sq + jnp.arange(sq)            # global query positions
 
@@ -164,9 +205,7 @@ def ring_attention_local(q_blk, k_blk, v_blk, *, axis: str,
                                  src_dev * k_cur.shape[0], m, l, o,
                                  causal)
 
-    m0 = jnp.full((h, sq), -jnp.inf, q_blk.dtype)
-    l0 = jnp.zeros((h, sq), q_blk.dtype)
-    o0 = jnp.zeros_like(q_blk)                  # (sq, h, d)
+    m0, l0, o0 = _acc_init(q_blk)
 
     def body(carry, step):
         # rotate first, then accumulate: the scan covers rotations
@@ -185,8 +224,7 @@ def ring_attention_local(q_blk, k_blk, v_blk, *, axis: str,
             body, (k_blk, v_blk, my, m, l, o),
             jnp.arange(n_dev - 1),
         )
-    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros
-    return o / l.transpose(1, 0)[:, :, None]
+    return _acc_finalize(o, l, q_blk.dtype)
 
 
 def _build_ring_attention(mesh, axis: str, causal: bool):

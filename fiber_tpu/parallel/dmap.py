@@ -37,18 +37,28 @@ def _stack_items(items: List[Any]):
     import jax
     import numpy as np
 
+    first = items[0]
+    if isinstance(first, (int, float, complex, np.generic)) or (
+            isinstance(first, np.ndarray) and first.ndim == 0):
+        # Scalar items: np.asarray builds the batch in one C pass.
+        # np.stack walks item-by-item (asarray each + concatenate) and
+        # was the single largest warm-call cost at pop-size item counts
+        # (~7 of 11 ms for 4096 scalars).
+        return np.asarray(items)
     return jax.tree.map(lambda *leaves: np.stack(leaves), *items)
 
 
-def _compiled_mapper(fn: Callable, mesh, multi_arg: bool):
-    """jit(shard_map(vmap(fn))) over the pool axis, cached per (fn, mesh)."""
+def _compiled_mapper(fn: Callable, mesh, multi_arg: bool,
+                     donate: bool = False):
+    """jit(shard_map(vmap(fn))) over the pool axis, cached per
+    (fn, mesh, donate)."""
     import jax
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
     try:
         hash(fn)
-        key = (fn, mesh, multi_arg)
+        key = (fn, mesh, multi_arg, donate)
     except TypeError:
         key = None  # unhashable callable: compile uncached
     if key is not None:
@@ -74,13 +84,76 @@ def _compiled_mapper(fn: Callable, mesh, multi_arg: bool):
     def run(batched):
         return mapped(batched)
 
-    compiled = jax.jit(run)
+    compiled = jax.jit(run, donate_argnums=(0,) if donate else ())
     if key is not None:
         with _cache_lock:
             _compile_cache[key] = compiled
             while len(_compile_cache) > _CACHE_MAX:
                 _compile_cache.popitem(last=False)
     return compiled
+
+
+class DeviceMapPlan:
+    """Reusable ``device_map``: mesh, sharding, and the compiled SPMD
+    program are resolved ONCE, then every call only stacks, pads,
+    transfers, and runs. For repeated maps of same-shaped batches this
+    removes the per-call resolution work, and ``donate=True``
+    additionally donates the input device buffer to the program so the
+    output can reuse its HBM (halves the allocator footprint of tight
+    map loops; the transferred buffer is consumed, which is safe here
+    because the plan device_puts a fresh one each call).
+
+    The per-call host->device transfer itself is NOT avoidable for
+    host-resident items — callers whose data already lives on the
+    device should stay inside jit (e.g. :func:`fiber_tpu.ops.es`'s
+    fused runner) rather than round-tripping through a host map.
+    """
+
+    def __init__(self, fn: Callable, mesh=None, star: bool = False,
+                 donate: bool = False) -> None:
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from fiber_tpu.parallel.mesh import default_mesh
+
+        self.fn = fn
+        self.mesh = mesh or default_mesh()
+        self.star = star
+        self.donate = donate
+        self._n_dev = int(np.prod(list(self.mesh.shape.values())))
+        self._sharding = NamedSharding(self.mesh, P("pool"))
+        self._compiled = _compiled_mapper(fn, self.mesh, multi_arg=star,
+                                          donate=donate)
+
+    def __call__(self, iterable: Iterable[Any]) -> List[Any]:
+        import jax
+        import numpy as np
+
+        if isinstance(iterable, np.ndarray) and iterable.ndim >= 1:
+            n = len(iterable)          # already batched along axis 0
+            batched = iterable
+        else:
+            items = list(iterable)
+            n = len(items)
+            batched = _stack_items(items) if n else None
+        if not n:
+            return []
+        pad = (-n) % self._n_dev
+        if pad:
+            batched = jax.tree.map(
+                lambda a: np.concatenate(
+                    [a, np.repeat(a[-1:], pad, axis=0)]),
+                batched,
+            )
+        device_in = jax.tree.map(
+            lambda a: jax.device_put(np.asarray(a), self._sharding),
+            batched,
+        )
+        out = self._compiled(device_in)
+        host = jax.device_get(out)
+        if not isinstance(host, (np.ndarray, np.generic)):
+            return [jax.tree.map(lambda a: a[i], host) for i in range(n)]
+        return [host[i] for i in range(n)]
 
 
 def device_map(
@@ -94,39 +167,19 @@ def device_map(
     Items may be scalars, arrays, or pytrees of arrays (all with identical
     structure/shapes). With ``star=True`` each item is a tuple of
     positional args. Returns a list of host (numpy) results in order.
+    One-shot form of :class:`DeviceMapPlan` (the compiled program is
+    still cached across calls; the plan additionally pins the
+    mesh/sharding resolution and offers input-buffer donation).
     """
-    import jax
     import numpy as np
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from fiber_tpu.parallel.mesh import default_mesh
-
-    items = list(iterable)
-    if not items:
+    if not isinstance(iterable, np.ndarray):
+        iterable = list(iterable)
+    if len(iterable) == 0:
+        # Before any mesh/compile work: an empty map must stay a no-op
+        # (no backend resolution, no compile-cache entry pinning fn).
         return []
-    mesh = mesh or default_mesh()
-    n = len(items)
-    n_dev = int(np.prod(list(mesh.shape.values())))
-
-    batched = _stack_items(items)
-    pad = (-n) % n_dev
-    if pad:
-        batched = jax.tree.map(
-            lambda a: np.concatenate([a, np.repeat(a[-1:], pad, axis=0)]),
-            batched,
-        )
-
-    sharding = NamedSharding(mesh, P("pool"))
-    device_in = jax.tree.map(
-        lambda a: jax.device_put(np.asarray(a), sharding), batched
-    )
-    compiled = _compiled_mapper(fn, mesh, multi_arg=star)
-    out = compiled(device_in)
-    host = jax.device_get(out)
-    leaves_are_tree = not isinstance(host, (np.ndarray, np.generic))
-    if leaves_are_tree:
-        return [jax.tree.map(lambda a: a[i], host) for i in range(n)]
-    return [host[i] for i in range(n)]
+    return DeviceMapPlan(fn, mesh=mesh, star=star)(iterable)
 
 
 def clear_device_map_cache() -> None:

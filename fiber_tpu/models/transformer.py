@@ -27,8 +27,10 @@ class TinyLM:
     ``"ring"`` (sequence sharded via ppermute ring + online softmax),
     ``"ulysses"`` (all-to-all head/seq swap; needs
     ``heads % n_devices == 0``), ``"flash"`` (the Pallas
-    flash-attention kernels, forward AND backward — single device,
-    whole sequence in HBM, scores streamed through VMEM), or
+    flash-attention kernels, forward AND backward — single device runs
+    them directly with the whole sequence in HBM and scores streamed
+    through VMEM; on a multi-device mesh the sequence shards over the
+    ring with the kernel as every rotation's per-device block), or
     ``"reference"`` (full score matrix, single device — for parity
     tests).
 
@@ -52,18 +54,25 @@ class TinyLM:
             raise ValueError(f"dim {dim} not divisible by heads {heads}")
         if attention not in ("ring", "ulysses", "flash", "reference"):
             raise ValueError(f"unknown attention {attention!r}")
-        if attention == "flash" and mesh is not None:
+        self._flash_multi = False
+        if mesh is not None:
             import numpy as np
 
-            if int(np.prod(list(mesh.shape.values()))) > 1:
-                # Loud, at construction: flash is the single-device
-                # plane (whole sequence on one chip, scores in VMEM);
-                # silently ignoring the mesh would look like sequence
-                # scaling and OOM at exactly the lengths ring/ulysses
-                # exist for.
+            multi = int(np.prod(list(mesh.shape.values()))) > 1
+            if multi and "pool" not in mesh.shape:
+                # Loud, at construction: the sequence-parallel planes
+                # shard over the mesh's "pool" axis — without this
+                # check the mistake surfaces as a KeyError deep inside
+                # the first apply().
                 raise ValueError(
-                    "attention='flash' is single-device; use 'ring' or "
-                    "'ulysses' to shard the sequence over a mesh")
+                    "multi-device TinyLM needs a mesh with a 'pool' "
+                    f"axis; got axes {tuple(mesh.shape)}")
+            if multi and attention == "flash":
+                # Multi-device flash = ring attention with the Pallas
+                # kernel as the per-device block: the sequence shards
+                # over the mesh AND every rotation streams scores
+                # through VMEM (ring_attention local="flash").
+                self._flash_multi = True
         self.vocab = vocab
         self.dim = dim
         self.heads = heads
@@ -121,6 +130,12 @@ class TinyLM:
 
             # Interpreter off-TPU so parity tests run anywhere; the
             # kernel proper needs Mosaic.
+            if self._flash_multi:
+                from fiber_tpu.ops.ring_attention import ring_attention
+
+                return ring_attention(
+                    q, k, v, mesh=self._mesh, causal=True,
+                    local="flash", interpret=not flash_available())
             return flash_attention(q, k, v, causal=True,
                                    interpret=not flash_available())
         if self.attention == "ulysses":

@@ -263,8 +263,29 @@ def flash_attention(q, k, v, *, causal: bool = False,
     return fn(q, k, v)
 
 
+def flash_attention_lse(q, k, v, *, causal: bool = False,
+                        block_q: int = 512, block_kv: int = 512,
+                        interpret: bool = False):
+    """Like :func:`flash_attention` but also returns the per-row
+    logsumexp ``(heads, S) float32`` — the residual that makes partial
+    attentions MERGEABLE (ring composition:
+    :func:`fiber_tpu.ops.ring_attention.ring_attention_local` with
+    ``local="flash"`` combines per-rotation (out, lse) pairs exactly).
+
+    Differentiable in BOTH outputs: the lse cotangent enters the
+    FlashAttention-2 backward as ``ds += dlse * p``, which folds into
+    the existing delta term (``delta - dlse``) at zero extra kernel
+    cost.
+    """
+    fn = _build_lse(q.shape, str(q.dtype), causal, block_q, block_kv,
+                    interpret)
+    return fn(q, k, v)
+
+
 @functools.lru_cache(maxsize=64)
-def _build(shape, dtype, causal, block_q, block_kv, interpret):
+def _build_calls(shape, dtype, causal, block_q, block_kv, interpret):
+    """The three pallas_call programs (fwd, dq, dkv) for one config —
+    shared by the out-only and the (out, lse) entry points."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -326,6 +347,16 @@ def _build(shape, dtype, causal, block_q, block_kv, interpret):
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
     )
+    return fwd_call, dq_call, dkv_call
+
+
+def _make_attn(shape, dtype, causal, block_q, block_kv, interpret,
+               with_lse: bool):
+    import jax
+    import jax.numpy as jnp
+
+    fwd_call, dq_call, dkv_call = _build_calls(
+        shape, dtype, causal, block_q, block_kv, interpret)
 
     def _fwd_core(q, k, v):
         """(S,H,D) API -> (H,S,D) kernels and back."""
@@ -333,28 +364,65 @@ def _build(shape, dtype, causal, block_q, block_kv, interpret):
                             jnp.swapaxes(v, 0, 1))
         return jnp.swapaxes(out, 0, 1), lse
 
-    @jax.custom_vjp
-    def attn(q, k, v):
-        out, _ = _fwd_core(q, k, v)
-        return out
-
-    def attn_fwd(q, k, v):
-        out, lse = _fwd_core(q, k, v)
-        return out, (q, k, v, out, lse)
-
-    def attn_bwd(res, dout):
-        q, k, v, out, lse = res
+    def _bwd_core(q, k, v, out, lse, dout, dlse):
+        # ds_ij = p_ij * (dp_ij - delta_i + dlse_i): the lse cotangent
+        # is exactly a -dlse shift of delta (d lse_i / d s_ij = p_ij),
+        # so both backward kernels run unchanged.
         delta = jnp.einsum(
             "shd,shd->hs", dout.astype(jnp.float32),
             out.astype(jnp.float32))
+        if dlse is not None:
+            delta = delta - dlse.astype(jnp.float32)
         qt, kt, vt = (jnp.swapaxes(x, 0, 1) for x in (q, k, v))
         dot = jnp.swapaxes(dout, 0, 1)
         dq = dq_call(qt, kt, vt, dot, lse, delta)
         dk, dv = dkv_call(qt, kt, vt, dot, lse, delta)
         return tuple(jnp.swapaxes(g, 0, 1) for g in (dq, dk, dv))
 
-    attn.defvjp(attn_fwd, attn_bwd)
-    return jax.jit(attn)
+    if not with_lse:
+        @jax.custom_vjp
+        def attn(q, k, v):
+            out, _ = _fwd_core(q, k, v)
+            return out
+
+        def attn_fwd(q, k, v):
+            out, lse = _fwd_core(q, k, v)
+            return out, (q, k, v, out, lse)
+
+        def attn_bwd(res, dout):
+            q, k, v, out, lse = res
+            return _bwd_core(q, k, v, out, lse, dout, None)
+
+        attn.defvjp(attn_fwd, attn_bwd)
+        return jax.jit(attn)
+
+    @jax.custom_vjp
+    def attn_lse(q, k, v):
+        return _fwd_core(q, k, v)
+
+    def attn_lse_fwd(q, k, v):
+        out, lse = _fwd_core(q, k, v)
+        return (out, lse), (q, k, v, out, lse)
+
+    def attn_lse_bwd(res, cots):
+        q, k, v, out, lse = res
+        dout, dlse = cots
+        return _bwd_core(q, k, v, out, lse, dout, dlse)
+
+    attn_lse.defvjp(attn_lse_fwd, attn_lse_bwd)
+    return jax.jit(attn_lse)
+
+
+@functools.lru_cache(maxsize=64)
+def _build(shape, dtype, causal, block_q, block_kv, interpret):
+    return _make_attn(shape, dtype, causal, block_q, block_kv,
+                      interpret, with_lse=False)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_lse(shape, dtype, causal, block_q, block_kv, interpret):
+    return _make_attn(shape, dtype, causal, block_q, block_kv,
+                      interpret, with_lse=True)
 
 
 def flash_available() -> bool:

@@ -164,11 +164,106 @@ def blockwise_attention(q, k, v, causal: bool = False):
     return _acc_finalize(o, l, q.dtype)
 
 
+def _merge_partials(o1, lse1, o2, lse2):
+    """Exactly combine two partial attentions over disjoint KV sets.
+
+    Each partial is (o: (sq, h, d) f32 — softmax-normalized over its own
+    KV set, lse: (h, sq) f32 — that set's logsumexp). The merge is the
+    standard flash rescaling; associative, so rotation order doesn't
+    matter. A skipped contribution carries lse = -1e30, making its
+    weight exp(-1e30 - m) == 0 (never NaN — the other side is finite
+    because the diagonal block always contributes)."""
+    import jax.numpy as jnp
+
+    m = jnp.maximum(lse1, lse2)                     # (h, sq)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    denom = w1 + w2
+    w1t = (w1 / denom).transpose(1, 0)[:, :, None]  # (sq, h, 1)
+    w2t = (w2 / denom).transpose(1, 0)[:, :, None]
+    return o1 * w1t + o2 * w2t, m + jnp.log(denom)
+
+
+def _ring_flash_local(q_blk, k_blk, v_blk, *, axis: str, n_dev: int,
+                      causal: bool, interpret: bool):
+    """Ring attention with the Pallas flash kernel as the per-device
+    block: each rotation runs flash over (local Q, visiting KV) and the
+    (out, lse) partials merge exactly (:func:`_merge_partials`).
+
+    Causality with rotating KV blocks is a THREE-WAY split on global
+    block position — the kernel's own causal flag only knows local
+    coordinates: the diagonal (src == my) runs the causal kernel,
+    fully-past blocks (src < my) run the unmasked kernel (every KV
+    position precedes every Q position), fully-future blocks are
+    skipped (lse = -1e30 zeroes them in the merge). ``lax.cond`` on the
+    traced src index picks the branch at runtime; differentiable end to
+    end (flash_attention_lse carries a custom VJP in both outputs).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from fiber_tpu.ops.pallas_attention import flash_attention_lse
+
+    sq, h, _ = q_blk.shape
+    my = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def full_block(k_cur, v_cur):
+        o, lse = flash_attention_lse(q_blk, k_cur, v_cur, causal=False,
+                                     interpret=interpret)
+        return o.astype(jnp.float32), lse
+
+    def diag_block(k_cur, v_cur):
+        o, lse = flash_attention_lse(q_blk, k_cur, v_cur, causal=True,
+                                     interpret=interpret)
+        return o.astype(jnp.float32), lse
+
+    def skip_block(k_cur, v_cur):
+        return (jnp.zeros(q_blk.shape, jnp.float32),
+                jnp.full((h, sq), -1e30, jnp.float32))
+
+    def one_rotation(k_cur, v_cur, src):
+        if not causal:
+            return full_block(k_cur, v_cur)
+        return jax.lax.cond(
+            src == my,
+            diag_block,
+            lambda kc, vc: jax.lax.cond(
+                src < my, full_block, skip_block, kc, vc),
+            k_cur, v_cur,
+        )
+
+    o, lse = one_rotation(k_blk, v_blk, my)  # local block first
+
+    def body(carry, _):
+        k_cur, v_cur, src, o, lse = carry
+        k_cur = jax.lax.ppermute(k_cur, axis, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis, perm)
+        src = (src - 1) % n_dev
+        o2, lse2 = one_rotation(k_cur, v_cur, src)
+        o, lse = _merge_partials(o, lse, o2, lse2)
+        return (k_cur, v_cur, src, o, lse), None
+
+    if n_dev > 1:
+        (_, _, _, o, lse), _ = jax.lax.scan(
+            body, (k_blk, v_blk, my, o, lse), None, length=n_dev - 1)
+    return o.astype(q_blk.dtype)
+
+
 def ring_attention_local(q_blk, k_blk, v_blk, *, axis: str,
                          n_devices: int | None = None,
-                         causal: bool = False):
+                         causal: bool = False,
+                         local: str = "xla",
+                         interpret: bool = False):
     """The raw per-device ring-attention body, for COMPOSITION inside a
     caller's own ``shard_map``.
+
+    ``local`` picks the per-device block engine: ``"xla"`` (chunked
+    online-softmax in plain jnp — differentiable everywhere) or
+    ``"flash"`` (the Pallas flash kernels — the flagship long-context
+    configuration: scores stream through VMEM on every rotation;
+    ``interpret=True`` runs them in the Pallas interpreter for
+    CPU-mesh tests).
 
     ``q_blk/k_blk/v_blk`` are this device's (seq/n_devices, heads,
     head_dim) shards along a mesh axis named ``axis``; the KV blocks
@@ -187,6 +282,15 @@ def ring_attention_local(q_blk, k_blk, v_blk, *, axis: str,
 
     n_dev = (int(jax.lax.axis_size(axis)) if n_devices is None
              else n_devices)
+    if local == "flash":
+        return _ring_flash_local(q_blk, k_blk, v_blk, axis=axis,
+                                 n_dev=n_dev, causal=causal,
+                                 interpret=interpret)
+    # "blockwise" is ulysses_attention's name for the same chunked
+    # online-softmax engine — accepted here so the two sequence-parallel
+    # planes share an engine vocabulary.
+    if local not in ("xla", "blockwise"):
+        raise ValueError(f"unknown local attention engine {local!r}")
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
     sq = q_blk.shape[0]
     my = jax.lax.axis_index(axis)
@@ -227,21 +331,22 @@ def ring_attention_local(q_blk, k_blk, v_blk, *, axis: str,
     return _acc_finalize(o, l, q_blk.dtype)
 
 
-def _build_ring_attention(mesh, axis: str, causal: bool):
+def _build_ring_attention(mesh, axis: str, causal: bool,
+                          local: str = "xla", interpret: bool = False):
     import functools
 
     import jax
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    local = functools.partial(
+    body = functools.partial(
         ring_attention_local, axis=axis, n_devices=mesh.shape[axis],
-        causal=causal,
+        causal=causal, local=local, interpret=interpret,
     )
 
     spec = P(axis)
     return jax.jit(shard_map(
-        local,
+        body,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
@@ -256,23 +361,27 @@ def ring_attention(
     mesh=None,
     axis: str = "pool",
     causal: bool = False,
+    local: str = "xla",
+    interpret: bool = False,
 ):
     """Exact attention with sequence sharded over the mesh.
 
     q, k, v: (seq, heads, head_dim) — ``seq`` must divide evenly over the
-    axis. Returns (seq, heads, head_dim) with the same sharding. The
-    compiled program is cached per (mesh, axis, causal); shapes re-use
-    jit's own cache.
+    axis. Returns (seq, heads, head_dim) with the same sharding.
+    ``local="flash"`` runs the Pallas flash kernels as the per-device
+    block (``interpret=True`` for CPU-mesh testing). The compiled
+    program is cached per (mesh, axis, causal, local, interpret);
+    shapes re-use jit's own cache.
     """
     from fiber_tpu.parallel.mesh import default_mesh
 
     mesh = mesh or default_mesh()
     # Mesh hashes by value (devices + axis names): no id-aliasing after GC,
     # and equal meshes share the compiled program.
-    key = (mesh, axis, causal)
+    key = (mesh, axis, causal, local, interpret)
     fn = _compiled_cache.get(key)
     if fn is None:
-        fn = _build_ring_attention(mesh, axis, causal)
+        fn = _build_ring_attention(mesh, axis, causal, local, interpret)
         _compiled_cache[key] = fn
     return fn(q, k, v)
 

@@ -108,6 +108,113 @@ def test_tiny_lm_flash_attention_parity():
         assert np.abs(a - b).max() < 5e-4, np.abs(a - b).max()
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_composition_matches_reference(causal):
+    """ring_attention(local="flash"): the Pallas kernel as the
+    per-device block, partial (out, lse) pairs merged across rotations
+    (VERDICT r3 #4 — the flagship long-context plane must run the
+    flagship kernel). Exact vs the full-matrix reference on the
+    8-device CPU mesh, interpret mode."""
+    from fiber_tpu.ops.ring_attention import ring_attention
+
+    q, k, v = _rand_qkv(256, 4, 16)
+    got = np.asarray(jax.device_get(ring_attention(
+        q, k, v, causal=causal, local="flash", interpret=True)))
+    want = np.asarray(jax.device_get(
+        reference_attention(q, k, v, causal=causal)))
+    assert np.abs(got - want).max() < 2e-5
+
+
+def test_ring_flash_gradients_match_reference():
+    """The lse cotangent path (flash_attention_lse custom VJP: delta -
+    dlse) composed through the ring merge produces exact dq/dk/dv."""
+    from fiber_tpu.ops.ring_attention import ring_attention
+
+    q, k, v = _rand_qkv(256, 4, 16)
+
+    def loss_flash(q, k, v):
+        o = ring_attention(q, k, v, causal=True, local="flash",
+                           interpret=True)
+        return jnp.sum(o ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        a = np.asarray(jax.device_get(a))
+        b = np.asarray(jax.device_get(b))
+        rel = np.abs(a - b).max() / (np.abs(b).max() + 1e-9)
+        assert rel < 1e-4, rel
+
+
+def test_flash_attention_lse_values():
+    """flash_attention_lse's second output IS the softmax logsumexp
+    (scaled scores), the mergeable residual."""
+    from fiber_tpu.ops.pallas_attention import flash_attention_lse
+
+    q, k, v = _rand_qkv(256, 2, 64)
+    out, lse = flash_attention_lse(q, k, v, causal=False, block_q=128,
+                                   block_kv=128, interpret=True)
+    s = jnp.einsum("qhd,khd->hqk", q, k) / jnp.sqrt(
+        jnp.asarray(q.shape[-1], jnp.float32))
+    want_lse = jax.nn.logsumexp(s, axis=-1)          # (h, sq)
+    assert np.abs(np.asarray(lse) - np.asarray(want_lse)).max() < 2e-5
+    want_out = reference_attention(q, k, v, causal=False)
+    assert np.abs(np.asarray(out) - np.asarray(want_out)).max() < 2e-5
+
+
+def test_tiny_lm_multi_device_flash_trains():
+    """TinyLM(attention="flash") on a multi-device mesh — previously a
+    construction-time error — now trains through ring+flash with the
+    sequence sharded over all 8 devices, loss/grad parity with the
+    reference plane."""
+    from fiber_tpu.models import TinyLM, make_train_step
+    from fiber_tpu.parallel import default_mesh
+
+    mesh = default_mesh()
+    kwargs = dict(vocab=64, dim=32, heads=2, layers=1, max_seq=128)
+    lm_flash = TinyLM(attention="flash", mesh=mesh, **kwargs)
+    lm_ref = TinyLM(attention="reference", **kwargs)
+    params = lm_flash.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (128,), 0, 64)
+
+    lf, gf = jax.value_and_grad(lm_flash.loss)(params, tokens)
+    lr, gr = jax.value_and_grad(lm_ref.loss)(params, tokens)
+    assert abs(float(lf) - float(lr)) < 1e-4
+    for a, b in zip(jax.tree_util.tree_leaves(gf),
+                    jax.tree_util.tree_leaves(gr)):
+        a = np.asarray(jax.device_get(a))
+        b = np.asarray(jax.device_get(b))
+        assert np.abs(a - b).max() < 5e-4, np.abs(a - b).max()
+
+    # And an optimizer step actually runs end to end on the mesh.
+    import optax
+
+    opt = optax.adamw(1e-3)
+    step = make_train_step(lm_flash, opt)
+    p2, _, loss = step(params, opt.init(params), tokens)
+    assert np.isfinite(float(loss))
+    assert any(
+        not np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree_util.tree_leaves(params),
+                        jax.tree_util.tree_leaves(p2)))
+
+
+def test_tiny_lm_rejects_poolless_multi_device_mesh():
+    """A multi-device mesh without the 'pool' axis must fail loudly at
+    construction (the planes shard over 'pool'; the old failure was a
+    KeyError deep inside the first apply)."""
+    from jax.sharding import Mesh
+
+    from fiber_tpu.models import TinyLM
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("data",))
+    with pytest.raises(ValueError, match="pool"):
+        TinyLM(attention="flash", mesh=mesh)
+
+
 def test_ring_intra_block_chunking_exact():
     """The kv-chunked accumulate (what makes single-chip long context
     fit in HBM: scores bounded at (h, sq, _KV_CHUNK)) stays exact and

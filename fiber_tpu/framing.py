@@ -65,10 +65,23 @@ def recv_frame_timeout(
     """recv_frame with a timeout; returns None if no frame *starts* within
     the timeout. The wait applies only before the first byte — once a frame
     has begun, it is read to completion, so a timeout can never strand
-    partially-consumed bytes and desynchronize the stream."""
+    partially-consumed bytes and desynchronize the stream.
+
+    poll(), not select(): select.select rejects any fd >= FD_SETSIZE
+    (1024) with "filedescriptor out of range", which a busy master —
+    hundreds of workers x (socket + log file + pipe) — exceeds in
+    normal operation (reference regression: fiber
+    tests/test_popen.py:96-113; pinned by
+    tests/test_process.py::test_transport_works_past_1024_fds)."""
+    import math
     import select
 
-    readable, _, _ = select.select([sock], [], [], timeout)
-    if not readable:
+    poller = select.poll()
+    poller.register(sock.fileno(), select.POLLIN)
+    # ceil, not truncate: a 0.5 ms wait must not silently become a
+    # busy-poll (poll takes whole milliseconds).
+    timeout_ms = (None if timeout is None
+                  else max(0, math.ceil(timeout * 1000)))
+    if not poller.poll(timeout_ms):
         return None
     return recv_frame(sock)

@@ -1016,6 +1016,12 @@ class Pool:
                     ("task", seq, base, digest, blob, chunk, star)
                 )
                 self._taskq.put((payload, (seq, base)))
+        if self._resilient:
+            # New chunks can clear parked requests' reservation gates.
+            try:
+                self._task_ep.wake()
+            except Exception:
+                pass
         return result
 
     # -- public API --------------------------------------------------------
@@ -1332,14 +1338,107 @@ class ResilientPool(Pool):
 
     # Task handout: answer each worker's "ready" request with a task and
     # record it in the pending table until its result arrives.
+    #
+    # Reservation gate (reference regression, fiber
+    # tests/test_pool.py:179-234): the worker-side fetch thread
+    # pipelines — it requests chunk N+1 while chunk N computes — so
+    # without a gate a fast worker's SECOND request can win a scarce
+    # chunk over a sibling's FIRST, serializing two tasks that must run
+    # concurrently (interlocked workloads then deadlock). A repeat
+    # request (ident already has unfinished chunks) is therefore parked
+    # whenever the queued chunks don't exceed one-per-potentially-idle
+    # worker; parked requests are re-evaluated every loop turn and
+    # answered out of order via the rep endpoint's recv_req/reply.
+    # With chunks plentiful (the normal pipelined regime) the gate
+    # passes immediately, so the REQ/REP overlap that closed the 10 ms
+    # overhead gap is untouched.
+
+    def _gate_allows(self, ident: bytes) -> bool:
+        # Serve if the requester is idle (no unfinished chunks), or if
+        # enough chunks remain to leave one for every worker that has
+        # none. qsize() is approximate; the gate re-evaluates each turn.
+        with self._pending_lock:
+            if not self._pending.get(ident):
+                return True
+            busy = sum(1 for t in self._pending.values() if t)
+        reserve = max(0, self._n_workers - busy)
+        return self._taskq.qsize() > reserve
+
     def _task_loop(self) -> None:
         # Runs until the pool's transport shuts down (join/terminate close
         # the endpoints → recv raises). During a close() drain it keeps
         # answering "ready" requests — with remaining tasks first, then
         # with exit messages so every worker is released.
-        while True:
+        parked: Dict[bytes, Tuple[Any, int]] = {}  # ident -> (chan, pid)
+
+        def drain_done() -> bool:
+            return (self._closed and self._store.outstanding() == 0
+                    and self._taskq.empty())
+
+        def reply_exit(chan) -> None:
             try:
-                req = self._task_ep.recv(timeout=0.5)
+                self._task_ep.reply(chan, serialization.dumps(_EXIT))
+            except (TransportClosed, OSError):
+                pass
+
+        def serve(ident: bytes, fiber_pid: int, chan) -> None:
+            """Hand the next chunk (or exit) to one cleared requester;
+            re-parks nothing — the caller already passed the gate."""
+            item = None
+            while item is None:
+                if self._terminated:
+                    return
+                if drain_done():
+                    reply_exit(chan)
+                    return
+                try:
+                    item = self._taskq.get(timeout=0.5)
+                except pyqueue.Empty:
+                    continue
+                if item is None:
+                    return
+            payload, key = item
+            with self._pending_lock:
+                # The worker may have been reaped while we waited for a
+                # task — its pending table is gone and nobody would
+                # ever resubmit this chunk. Requeue for the next
+                # "ready".
+                if (fiber_pid in self._reaped_pids
+                        or ident in self._dead_idents):
+                    self._taskq.put(item)
+                    return
+                self._pending.setdefault(ident, {})[key] = payload
+            try:
+                self._task_ep.reply(chan, payload)
+            except (TransportClosed, OSError):
+                # Requester died between asking and receiving; put the
+                # chunk back for the next "ready" and keep serving.
+                with self._pending_lock:
+                    self._pending.get(ident, {}).pop(key, None)
+                self._taskq.put(item)
+
+        while True:
+            # Re-evaluate parked requests first: results arriving or
+            # chunks queueing since last turn may have cleared gates.
+            for ident in list(parked):
+                chan, pid = parked[ident]
+                with self._pending_lock:
+                    stale = (pid in self._reaped_pids
+                             or ident in self._dead_idents)
+                if stale or not chan.alive:
+                    del parked[ident]
+                    if stale:
+                        reply_exit(chan)
+                    continue
+                if drain_done():
+                    del parked[ident]
+                    reply_exit(chan)
+                    continue
+                if self._gate_allows(ident):
+                    del parked[ident]
+                    serve(ident, pid, chan)
+            try:
+                req, chan = self._task_ep.recv_req(timeout=0.5)
             except TimeoutError:
                 if self._terminated:
                     return
@@ -1358,59 +1457,33 @@ class ResilientPool(Pool):
                 stale = (fiber_pid in self._reaped_pids
                          or ident in self._dead_idents)
             if stale:
-                try:
-                    self._task_ep.send(serialization.dumps(_EXIT))
-                except (TransportClosed, OSError):
-                    pass
+                reply_exit(chan)
                 continue
             with self._pending_lock:
                 self._pending.setdefault(ident, {})
                 self._pid_to_idents.setdefault(fiber_pid, set()).add(ident)
-            item = None
-            while item is None:
-                if self._terminated:
-                    return
-                if self._closed and self._store.outstanding() == 0 and \
-                        self._taskq.empty():
-                    try:
-                        self._task_ep.send(serialization.dumps(_EXIT),
-                                           timeout=5.0)
-                    except (TimeoutError, TransportClosed, OSError):
-                        pass
-                    break
-                try:
-                    item = self._taskq.get(timeout=0.5)
-                except pyqueue.Empty:
-                    continue
-                if item is None:
-                    return
-            if item is None:
+            if self._terminated:
+                return
+            if drain_done():
+                reply_exit(chan)
                 continue
-            payload, key = item
-            with self._pending_lock:
-                # The worker may have been reaped while we waited for a
-                # task — its pending table is gone and nobody would ever
-                # resubmit this chunk. Requeue for the next "ready".
-                if (fiber_pid in self._reaped_pids
-                        or ident in self._dead_idents):
-                    self._taskq.put(item)
-                    continue
-                self._pending.setdefault(ident, {})[key] = payload
-            try:
-                self._task_ep.send(payload)
-            except (TransportClosed, OSError):
-                # Requester died between asking and receiving; put the
-                # chunk back for the next "ready" and keep serving.
-                with self._pending_lock:
-                    self._pending.get(ident, {}).pop(key, None)
-                self._taskq.put(item)
-                continue
+            if self._gate_allows(ident):
+                serve(ident, fiber_pid, chan)
+            else:
+                parked[ident] = (chan, fiber_pid)
 
     def _on_result(self, seq, base, values, ident) -> None:
         with self._pending_lock:
             table = self._pending.get(ident)
             if table is not None:
                 table.pop((seq, base), None)
+        # A completed chunk can clear a parked request's gate (the
+        # requester is now idle) — nudge the handout loop instead of
+        # letting it notice at its next recv timeout.
+        try:
+            self._task_ep.wake()
+        except Exception:
+            pass
 
     def _reclaim_ident(self, ident: bytes) -> int:
         """Retire one sub-worker ident: block future handouts to it, drop

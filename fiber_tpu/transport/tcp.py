@@ -39,6 +39,7 @@ logger = get_logger()
 MODES = ("r", "w", "rw", "req", "rep")
 
 _SENTINEL = object()
+_WAKE = object()  # recv_req nudge (Endpoint.wake), never delivered as data
 
 # Transport frame types (first payload byte). Only the w→r push pattern
 # uses credits; rw/req/rep frames are always DATA.
@@ -385,6 +386,12 @@ class Endpoint:
     def recv(self, timeout: Optional[float] = None) -> bytes:
         if self.mode == "w":
             raise TransportClosed("send-only endpoint")
+        if self.mode == "rep":
+            # One inbox protocol: the rep arm IS recv_req with the
+            # classic implicit-reply convention layered on.
+            frame, chan = self.recv_req(timeout)
+            self._reply_to = chan
+            return frame
         demand = self._demand_driven
         if demand:
             with self._recv_lock:
@@ -423,9 +430,41 @@ class Endpoint:
                     chan.send_credit(owed)
                 except OSError:
                     pass
-        if self.mode == "rep":
-            self._reply_to = chan
         return frame
+
+    def recv_req(self, timeout: Optional[float] = None):
+        """rep-mode receive that returns ``(payload, reply_handle)``
+        instead of arming the implicit ``_reply_to`` slot — so a server
+        can hold several requests open and answer them OUT OF ORDER
+        (the pool's reservation-gated handout parks "ready" requests
+        from busy workers while idle ones get first chunks). Answer
+        with :meth:`reply`. A :meth:`wake` nudge surfaces as
+        ``TimeoutError`` — the caller's timeout turn, just early."""
+        if self.mode != "rep":
+            raise TransportClosed("recv_req is for rep endpoints")
+        item = self._inbox.get(timeout=timeout)
+        if item is _SENTINEL_EMPTY or item is _WAKE:
+            raise TimeoutError("recv timed out")
+        if item is _SENTINEL:
+            self._inbox.put(_SENTINEL)  # wake other readers too
+            raise TransportClosed("endpoint closed")
+        chan, frame = item
+        return frame, chan
+
+    def wake(self) -> None:
+        """Nudge a reader blocked in :meth:`recv_req` to re-run its
+        loop turn now (used by the pool: a result arriving or a task
+        being queued can clear a parked request's gate — without the
+        nudge the handout would notice only at its next timeout)."""
+        self._inbox.put(_WAKE)
+
+    @staticmethod
+    def reply(handle, payload: bytes) -> None:
+        """Answer one request taken via :meth:`recv_req`. Raises
+        ``OSError``/``TransportClosed`` if that requester is gone."""
+        if not handle.alive:
+            raise TransportClosed("requester disconnected")
+        handle.send(payload)
 
     def poll(self, timeout: Optional[float] = 0.0) -> bool:
         """True if a data frame is ready (or arrives within timeout).

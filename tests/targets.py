@@ -360,3 +360,19 @@ def jax_distributed_es_step(rank, size):
     scale = max(1.0, abs(digest))
     assert spread / scale < 1e-6, (spread, digest)
     jax.distributed.shutdown()
+
+
+def interlocked_queue_worker(args):
+    """One end of an interlocked queue pair (reference chunk-size
+    regression, fiber tests/test_pool.py:179-234): announces READY,
+    then blocks for instructions that the master only sends after ALL
+    workers announced — so the map deadlocks unless every task landed
+    on a DISTINCT concurrently-running worker (chunksize accounting
+    and fair handout are both load-bearing here)."""
+    i, (instructions, returns) = args
+    returns.put(("READY", i))
+    while True:
+        ins = instructions.get(timeout=120)
+        if ins == "QUIT":
+            return i
+        returns.put(("ACK", i))

@@ -169,6 +169,42 @@ def test_maxtasksperchild_with_packing():
         fiber_tpu.init(cpu_per_job=1)
 
 
+def test_interlocked_queue_pairs_chunk_size_no_deadlock():
+    """Reference regression (fiber tests/test_pool.py:179-234): N tasks
+    that each ship a (instruction, return) SimpleQueue pair and block
+    until the master talks to ALL of them. Completes only if chunking
+    put exactly one task on each of N concurrently-live workers — a
+    miscalculated chunk (two interlocked tasks serialized on one
+    worker) or an unfair handout (one worker's transport window
+    hoarding a second task while a sibling idles) deadlocks the map.
+    Worker count crosses the cpu_per_job packing boundary (3 = 2 + 1)
+    like the reference's 9-vs-8."""
+    n = 3
+    fiber_tpu.init(cpu_per_job=2)
+    try:
+        queues = [(fiber_tpu.SimpleQueue(), fiber_tpu.SimpleQueue())
+                  for _ in range(n)]
+        with fiber_tpu.Pool(n) as pool:
+            assert pool.wait_workers(n, timeout=120)
+            res = pool.map_async(
+                targets.interlocked_queue_worker,
+                list(enumerate(queues)), chunksize=1,
+            )
+            for i, (_, returns) in enumerate(queues):
+                tag, j = returns.get(timeout=120)
+                assert (tag, j) == ("READY", i)
+            for instruction, _ in queues:
+                instruction.put("HELLO")
+            for i, (_, returns) in enumerate(queues):
+                tag, j = returns.get(timeout=120)
+                assert (tag, j) == ("ACK", i)
+            for instruction, _ in queues:
+                instruction.put("QUIT")
+            assert sorted(res.get(timeout=120)) == list(range(n))
+    finally:
+        fiber_tpu.init(cpu_per_job=1)
+
+
 def test_worker_start_escalation(monkeypatch):
     """A backend that refuses EVERY worker start while work is pending
     must fail the map loudly (round-2 verdict: the old behavior retried

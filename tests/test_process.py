@@ -157,3 +157,54 @@ def test_process_start_failure_surfaces_logs():
             p.start()
     finally:
         backend.create_job = orig
+
+
+def test_transport_works_past_1024_fds():
+    """select.select rejects fds >= FD_SETSIZE (1024), which a busy
+    master (hundreds of workers x socket + log + pipe) exceeds in
+    normal operation — the framing wait must be poll-based and the
+    whole process machinery must keep working with >1024 fds open
+    (reference regression: fiber tests/test_popen.py:96-113)."""
+    import os
+    import resource
+    import socket as pysocket
+
+    from fiber_tpu.framing import recv_frame_timeout, send_frame
+
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    want = 4096
+    try:
+        if soft < want:
+            new_hard = hard if hard == resource.RLIM_INFINITY \
+                else max(hard, want)
+            resource.setrlimit(resource.RLIMIT_NOFILE, (want, new_hard))
+    except (ValueError, OSError):
+        pytest.skip(f"cannot raise RLIMIT_NOFILE past {soft}")
+    held = [os.open(os.devnull, os.O_RDONLY)]
+    try:
+        while len(held) < 1100:
+            held.append(os.dup(held[0]))
+        a, b = pysocket.socketpair()
+        try:
+            assert a.fileno() > 1024 and b.fileno() > 1024
+            # The old select.select path raised
+            # "ValueError: filedescriptor out of range in select()".
+            assert recv_frame_timeout(a, 0.05) is None  # clean timeout
+            send_frame(b, b"ping")
+            assert recv_frame_timeout(a, 10.0) == b"ping"
+        finally:
+            a.close()
+            b.close()
+        # Full machinery with the fd table still >1024 entries deep: a
+        # worker launches, handshakes, runs, and reports its exit.
+        p = fiber_tpu.Process(target=targets.noop)
+        p.start()
+        p.join(60)
+        assert p.exitcode == 0
+    finally:
+        for fd in held:
+            os.close(fd)
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (soft, hard))
+        except (ValueError, OSError):
+            pass

@@ -3,7 +3,7 @@
 
 PYTEST ?= python -m pytest tests/ -q
 
-.PHONY: test stest test-all lint bench docs
+.PHONY: test stest test-all lint bench weakscale docs
 
 # Tier 1: local backend (subprocess jobs)
 test:
@@ -19,8 +19,18 @@ stest:
 
 test-all: test stest
 
+# FIBER_BENCH_ENFORCE: fail loudly when the 1 ms host-pool point
+# drifts past its budget (the driver's plain `python bench.py` only
+# records it).
 bench:
-	python bench.py
+	FIBER_BENCH_ENFORCE=1 python bench.py
+
+# Weak-scaling record over 1/2/4/8-device sim meshes (fused ES,
+# population scaled with devices) -> RUNS/weak_scaling.json. On chip
+# the same entry records real scaling.
+weakscale:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	JAX_PLATFORMS=cpu python __graft_entry__.py --weak-scaling
 
 lint:
 	python -m compileall -q fiber_tpu examples bench.py __graft_entry__.py

@@ -1016,7 +1016,7 @@ class Pool:
                     ("task", seq, base, digest, blob, chunk, star)
                 )
                 self._taskq.put((payload, (seq, base)))
-        if self._resilient:
+        if self._resilient and getattr(self, "_parked_count", 0):
             # New chunks can clear parked requests' reservation gates.
             try:
                 self._task_ep.wake()
@@ -1277,6 +1277,10 @@ class ResilientPool(Pool):
     def __init__(self, *args: Any, **kwargs: Any) -> None:
         # ident -> {(seq, base): (payload, nitems)}
         self._pending: Dict[bytes, Dict[Tuple[int, int], Tuple[bytes, int]]] = {}
+        #: len() of the handout loop's parked-request table, mirrored
+        #: here (single-writer: the task loop) so result/submit paths
+        #: can skip the wake nudge when nothing is waiting on a gate.
+        self._parked_count = 0
         self._pid_to_idents: Dict[int, set] = {}
         self._reaped_pids: set = set()
         # Dead-ident guard against stale "ready"s queued before a
@@ -1371,9 +1375,11 @@ class ResilientPool(Pool):
         # with exit messages so every worker is released.
         parked: Dict[bytes, Tuple[Any, int]] = {}  # ident -> (chan, pid)
 
+        def sync_parked() -> None:
+            self._parked_count = len(parked)
+
         def drain_done() -> bool:
-            return (self._closed and self._store.outstanding() == 0
-                    and self._taskq.empty())
+            return self._draining_done() and self._taskq.empty()
 
         def reply_exit(chan) -> None:
             try:
@@ -1427,15 +1433,18 @@ class ResilientPool(Pool):
                              or ident in self._dead_idents)
                 if stale or not chan.alive:
                     del parked[ident]
+                    sync_parked()
                     if stale:
                         reply_exit(chan)
                     continue
                 if drain_done():
                     del parked[ident]
+                    sync_parked()
                     reply_exit(chan)
                     continue
                 if self._gate_allows(ident):
                     del parked[ident]
+                    sync_parked()
                     serve(ident, pid, chan)
             try:
                 req, chan = self._task_ep.recv_req(timeout=0.5)
@@ -1471,6 +1480,7 @@ class ResilientPool(Pool):
                 serve(ident, fiber_pid, chan)
             else:
                 parked[ident] = (chan, fiber_pid)
+                sync_parked()
 
     def _on_result(self, seq, base, values, ident) -> None:
         with self._pending_lock:
@@ -1479,11 +1489,14 @@ class ResilientPool(Pool):
                 table.pop((seq, base), None)
         # A completed chunk can clear a parked request's gate (the
         # requester is now idle) — nudge the handout loop instead of
-        # letting it notice at its next recv timeout.
-        try:
-            self._task_ep.wake()
-        except Exception:
-            pass
+        # letting it notice at its next recv timeout. Skipped entirely
+        # while nothing is parked (the hot path of a plentiful-chunk
+        # map must not pay an inbox put per result).
+        if self._parked_count:
+            try:
+                self._task_ep.wake()
+            except Exception:
+                pass
 
     def _reclaim_ident(self, ident: bytes) -> int:
         """Retire one sub-worker ident: block future handouts to it, drop

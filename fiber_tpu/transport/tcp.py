@@ -89,6 +89,13 @@ class _Inbox:
     def qsize(self) -> int:
         return len(self._items)
 
+    def drop_leading(self, sentinel) -> None:
+        """Remove consecutive head items identical to ``sentinel`` (used
+        by poll() to consume wake nudges, which are not data)."""
+        with self._cond:
+            while self._items and self._items[0] is sentinel:
+                self._items.popleft()
+
 
 _SENTINEL_EMPTY = object()
 
@@ -195,6 +202,7 @@ class Endpoint:
         self._credit_outstanding = 0
         self._waiting_readers = 0
         self._recv_lock = threading.Lock()
+        self._wake_queued = False  # coalesces Endpoint.wake nudges
 
     # -- wiring -----------------------------------------------------------
     def bind(self, ip: str, port: int = 0) -> str:
@@ -444,6 +452,8 @@ class Endpoint:
             raise TransportClosed("recv_req is for rep endpoints")
         item = self._inbox.get(timeout=timeout)
         if item is _SENTINEL_EMPTY or item is _WAKE:
+            if item is _WAKE:
+                self._wake_queued = False
             raise TimeoutError("recv timed out")
         if item is _SENTINEL:
             self._inbox.put(_SENTINEL)  # wake other readers too
@@ -455,7 +465,13 @@ class Endpoint:
         """Nudge a reader blocked in :meth:`recv_req` to re-run its
         loop turn now (used by the pool: a result arriving or a task
         being queued can clear a parked request's gate — without the
-        nudge the handout would notice only at its next timeout)."""
+        nudge the handout would notice only at its next timeout).
+        Coalesced: at most one nudge sits in the inbox at a time (the
+        clear-after-pop race can drop a nudge, which costs one recv
+        timeout turn at worst — the fallback that existed anyway)."""
+        if self._wake_queued:
+            return
+        self._wake_queued = True
         self._inbox.put(_WAKE)
 
     @staticmethod
@@ -468,7 +484,10 @@ class Endpoint:
 
     def poll(self, timeout: Optional[float] = 0.0) -> bool:
         """True if a data frame is ready (or arrives within timeout).
-        Never consumes or reorders frames."""
+        Never consumes or reorders DATA frames (wake nudges are not
+        data and are consumed here so they can't masquerade as one)."""
+        self._inbox.drop_leading(_WAKE)
+        self._wake_queued = False
         if not self._inbox.empty():
             return not self._is_closed_head()
         if not timeout:

@@ -29,6 +29,7 @@ import runpy
 import shlex
 import subprocess
 import sys
+import time
 from typing import List, Optional
 
 
@@ -168,14 +169,55 @@ def cmd_agent(args) -> int:
     return host_agent.main(argv)
 
 
+def _run_shell(cmd: str) -> int:
+    """The one seam through which `up` touches the outside world (ssh /
+    gcloud) — tests monkeypatch this to stand up real local agents and
+    drive the whole bring-up end to end without cloud credentials."""
+    return subprocess.call(cmd, shell=True)
+
+
+def _wait_for_agents(hosts, timeout: float) -> int:
+    """Poll every agent until it answers ping (the reference's
+    wait-until-pod-running step, fiber/cli.py:402-410); prints one
+    status line per host. Returns 0 when all answered. Keyed by
+    (host, port) — several agents on one host (the local multi-agent
+    layout) are distinct waits."""
+    deadline = time.time() + timeout
+    remaining = set(hosts)
+    while remaining:
+        for host, port in sorted(remaining):
+            try:
+                info, _ = _probe_agent(host, port)
+            except Exception:
+                continue
+            print(f"up: {host}:{port} agent live "
+                  f"(cpus={info.get('cpu_count')})")
+            remaining.discard((host, port))
+        if not remaining:
+            return 0
+        if time.time() > deadline:
+            for host, port in sorted(remaining):
+                print(f"up: {host}:{port} NOT answering after "
+                      f"{timeout:.0f}s — check /tmp/fiber-agent.log "
+                      "on the host", file=sys.stderr)
+            return 1
+        time.sleep(0.5)
+    return 0
+
+
 def cmd_up(args) -> int:
-    """Emit (or run) agent-start commands for every pod-slice host.
+    """Bring the pod slice up: start an agent on every host over
+    ssh/gcloud, wait until they all answer, and report — the
+    reference's automated bring-up (fiber/cli.py:338-414: build, create
+    pod, attach) redesigned for TPU-VM slices. ``--dry-run`` prints the
+    commands instead of running them.
 
     A fresh cluster key is generated when the operator hasn't set one —
     pod agents bind non-loopback, and the agent refuses that with the
     well-known default key.
     """
     import secrets
+    import shutil
 
     from fiber_tpu.host_agent import DEFAULT_AGENT_PORT
 
@@ -188,36 +230,122 @@ def cmd_up(args) -> int:
             f"master:\nexport FIBER_CLUSTER_KEY={key}",
             file=sys.stderr,
         )
+    execute = not args.dry_run
+
     # Agents must share the operator's cluster key or every later
     # master/status/cp call fails HMAC auth.
-    agent_cmd = (
-        f"FIBER_CLUSTER_KEY={shlex.quote(key)} "
-        f"nohup {args.python} -m fiber_tpu.host_agent "
-        f"--port {port} --bind 0.0.0.0 >/tmp/fiber-agent.log 2>&1 &"
-    )
+    def agent_cmd(agent_port: int) -> str:
+        return (
+            f"FIBER_CLUSTER_KEY={shlex.quote(key)} "
+            f"nohup {args.python} -m fiber_tpu.host_agent "
+            f"--port {agent_port} --bind 0.0.0.0 "
+            ">/tmp/fiber-agent.log 2>&1 &"
+        )
+
+    def parse_up_hosts(spec: str):
+        # Unlike _parse_hosts, portless entries take --port (or the
+        # default) so the STARTED port and the PROBED port can never
+        # disagree.
+        out = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" in part:
+                h, p = part.rsplit(":", 1)
+                if not h or not p.isdigit():
+                    raise SystemExit(
+                        f"error: malformed host entry {part!r} "
+                        "(want ip or ip:port)")
+                out.append((h, int(p)))
+            else:
+                out.append((part, port))
+        return out
+
     if args.tpu:
-        base = (
+        driver = "gcloud"
+        cmds = [(
             f"gcloud compute tpus tpu-vm ssh {shlex.quote(args.tpu)} "
             + (f"--zone {shlex.quote(args.zone)} " if args.zone else "")
-            + "--worker all --command "
-        )
-        full = base + shlex.quote(agent_cmd)
-        print(full)
-        if args.execute:
-            return subprocess.call(full, shell=True)
-        print("# dry run — pass --execute to run", file=sys.stderr)
-        return 0
-    for host in _hosts_from_args(args).split(","):
-        host = host.strip().split(":")[0]
-        full = f"ssh {host} {shlex.quote(agent_cmd)}"
-        print(full)
-        if args.execute:
-            rc = subprocess.call(full, shell=True)
+            + "--worker all --command " + shlex.quote(agent_cmd(port))
+        )]
+        # gcloud addresses workers by name; probing needs addresses —
+        # the worker agents all listen on `port`, so --hosts entries
+        # here must carry that port (or none, which defaults to it).
+        probe_hosts = parse_up_hosts(args.hosts) if args.hosts else []
+    else:
+        driver = "ssh"
+        probe_hosts = parse_up_hosts(_hosts_from_args(args))
+        cmds = [
+            f"ssh {host} {shlex.quote(agent_cmd(host_port))}"
+            for host, host_port in probe_hosts
+        ]
+    if execute and shutil.which(driver) is None:
+        print(f"up: {driver!r} not found on PATH — printing commands "
+              "instead (run them on the hosts yourself, or fix PATH)",
+              file=sys.stderr)
+        execute = False
+    for cmd in cmds:
+        print(cmd)
+        if execute:
+            rc = _run_shell(cmd)
             if rc != 0:
+                print(f"up: driver exited {rc} for: {cmd}",
+                      file=sys.stderr)
                 return rc
-    if not args.execute:
-        print("# dry run — pass --execute to run", file=sys.stderr)
+    if not execute:
+        if not args.dry_run:
+            return 1  # driver missing — commands printed, but not up
+        print("# dry run — rerun without --dry-run to execute",
+              file=sys.stderr)
+        return 0
+    # Probe with the agents' key in scope: _probe_agent HMACs with it.
+    # Plain assignment, not setdefault — an exported-but-EMPTY var must
+    # not leave the probes on the default key while the agents run the
+    # generated one. When the env was set non-empty, key equals it.
+    os.environ["FIBER_CLUSTER_KEY"] = key
+    if probe_hosts:
+        rc = _wait_for_agents(probe_hosts, args.wait)
+        if rc == 0:
+            hosts_str = ",".join(f"{h}:{p}" for h, p in probe_hosts)
+            print(f"up: all agents live. Next:\n"
+                  f"  export FIBER_CLUSTER_KEY={key}\n"
+                  f"  FIBER_BACKEND=tpu FIBER_TPU_HOSTS={hosts_str} "
+                  "fiber-tpu run your_script.py")
+        return rc
+    print("up: agents started; pass --hosts to wait/verify "
+          "(gcloud names aren't probe addresses)", file=sys.stderr)
     return 0
+
+
+def cmd_down(args) -> int:
+    """Stop the agents `up` started: the shutdown RPC over the data
+    plane (no ssh round trip), per host. Agents terminate their live
+    jobs first."""
+    from fiber_tpu.backends.tpu import AgentClient
+
+    rc = 0
+    for host, port in _parse_hosts_cli(_hosts_from_args(args)):
+        client = AgentClient(host, port)
+        try:
+            # Ping FIRST: connection-refused on a dead host must surface
+            # as 'unreachable', not be swallowed as a mid-reply exit.
+            client.call("ping")
+            try:
+                client.call("shutdown")
+            except (EOFError, ConnectionError, OSError):
+                pass  # agent exits mid-reply; that IS success
+            print(f"down: {host}:{port} stopped")
+        except Exception as err:  # noqa: BLE001
+            print(f"down: {host}:{port} unreachable: {err!r}",
+                  file=sys.stderr)
+            rc = 1
+        finally:
+            try:
+                client.close()
+            except Exception:
+                pass
+    return rc
 
 
 def _probe_agent(host: str, port: int):
@@ -455,14 +583,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="allow put_file/get_file anywhere on disk")
     p.set_defaults(fn=cmd_agent)
 
-    p = sub.add_parser("up", help="start agents on every pod-slice host")
+    p = sub.add_parser(
+        "up", help="start agents on every pod-slice host and wait for "
+                   "them (--dry-run prints the commands instead)")
     p.add_argument("--hosts", default="")
     p.add_argument("--tpu", default="", help="TPU name (gcloud ssh path)")
     p.add_argument("--zone", default="")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--python", default="python3")
-    p.add_argument("--execute", action="store_true")
+    p.add_argument("--dry-run", action="store_true",
+                   help="print the bring-up commands without running")
+    p.add_argument("--wait", type=float, default=60.0,
+                   help="seconds to wait for agents to answer")
+    # pre-r4 compat: execution is the default now
+    p.add_argument("--execute", action="store_true",
+                   help=argparse.SUPPRESS)
     p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down", help="stop agents via their shutdown RPC")
+    p.add_argument("--hosts", default="")
+    p.set_defaults(fn=cmd_down)
 
     p = sub.add_parser("status", help="ping every host agent")
     p.add_argument("--hosts", default="")

@@ -23,14 +23,21 @@ Both kernels run in Pallas interpret mode on CPU for testing and are
 correctness-validated on hardware (noise quality, antithetic symmetry,
 and perturb/gradient regeneration agreement to ~1e-5 at bench shapes).
 
-**Measured outcome (recorded in RUNS/bench_tpu_success.json):** at the
-flagship workload's shapes the fused path LOSES to plain jnp by ~30x
-end-to-end — the custom-call grids serialize inside the rollout scan
-while XLA fuses its threefry noise into it, and HBM was not the
-bottleneck. ``use_pallas="auto"`` therefore resolves to the jnp path;
-pass ``use_pallas=True`` to force these kernels (regimes where the
-trade could flip: much larger dim·pop per device, HBM-bound eval_fns).
-``bench.py --ab-pallas`` records both paths' throughput on hardware.
+**STATUS: experimental, measured loser, retirement pending one final
+on-chip A/B.** The recorded fused-program A/B
+(RUNS/bench_tpu_success.json) measured this path ~30x SLOWER than
+plain jnp end-to-end at the flagship shapes — the custom-call grids
+serialize inside the rollout scan while XLA fuses its threefry noise
+into it, and HBM was not the bottleneck there. ``use_pallas="auto"``
+therefore resolves to the jnp path and NOTHING in the framework claims
+perf from these kernels (the kernel showcase is
+``ops/pallas_attention.py``: flash fwd+bwd+lse, composed into the ring
+plane). The module is kept one more round strictly as an A/B-able
+experiment: ``bench.py --ab-pallas`` (armed on the harvest loop)
+re-measures both paths the next time the chip answers; if that fresh
+record is again <1.0x, DELETE this module and its tests rather than
+maintain a losing path. Regimes where the trade could still flip:
+much larger dim·pop per device, HBM-bound eval_fns.
 """
 
 from __future__ import annotations

@@ -94,8 +94,29 @@ def tune_sweep() -> None:
             f"{best['best_evals_per_sec']} evals/s")
 
 
+def doctor_transcript(tag: str = "r4") -> None:
+    """Record `fiber-tpu doctor` from this host (VERDICT r3 #10:
+    environment regressions should be diagnosed from evidence, not
+    inferred from bench fallbacks). Runs tunnel-up or tunnel-down —
+    the down transcript is exactly the evidence of what was broken."""
+    rc, tail = run(
+        [sys.executable, "-m", "fiber_tpu.cli", "doctor",
+         "--timeout", "120"], timeout=300)
+    path = os.path.join(REPO, "RUNS", f"doctor_{tag}.txt")
+    with open(path, "w") as fh:
+        fh.write(f"# fiber-tpu doctor @ {time.strftime('%F %T')} "
+                 f"rc={rc}\n{tail}\n")
+    log(f"doctor transcript: rc={rc} -> {path}")
+
+
 def harvest() -> None:
     steps = [
+        # FIRST: the standalone shipping-defaults record — the
+        # 13,084-vs-473,122 evals/s reconciliation (VERDICT r3 weak #1)
+        # needs a fresh standalone number before any A/B or sweep
+        # mutates anything.
+        ("ES standalone (shipping defaults, reconciliation)",
+         [sys.executable, "bench.py", "--no-pool-bench"], 1500, None),
         ("pallas A/B",
          [sys.executable, "bench.py", "--ab-pallas", "--no-pool-bench",
           "--gens", "8"], 1500, None),
@@ -120,6 +141,7 @@ def harvest() -> None:
          [sys.executable, "bench.py", "--lm", "--seq", "8192"],
          2400, None),
     ]
+    doctor_transcript()
     for name, cmd, timeout, env in steps:
         if cmd is None:
             tune_sweep()

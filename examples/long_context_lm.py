@@ -17,8 +17,12 @@ Run:  python examples/long_context_lm.py [--seq 512] [--steps 300]
       [--attention ring|ulysses|flash]
 
 ``--attention flash`` trains through the Pallas flash-attention
-kernels instead (single device, whole sequence in HBM, scores streamed
-through VMEM — the kernel path `bench.py --lm` A/Bs on chip).
+kernels: on one device directly (whole sequence in HBM, scores
+streamed through VMEM), and on a multi-device mesh as the RING's
+per-device block — every rotation runs the kernel and the partial
+(out, lse) pairs merge exactly, so context length still scales with
+device count while the kernel does the math (`bench.py --lm` and
+`--attention` A/B the paths on chip).
 """
 
 import os as _os
@@ -41,12 +45,15 @@ def main():
     parser.add_argument("--layers", type=int, default=2)
     parser.add_argument("--attention", default="ring",
                         choices=("ring", "ulysses", "flash"))
+    parser.add_argument("--kv-heads", type=int, default=None,
+                        help="GQA: KV heads < heads (flash reads the "
+                             "small KV natively; XLA planes broadcast)")
     parser.add_argument("--lr", type=float, default=1e-3)
     args = parser.parse_args()
 
     import jax
 
-    n_dev_check = 1 if args.attention == "flash" else len(jax.devices())
+    n_dev_check = len(jax.devices())  # every plane shards now
     if args.seq % 2 or args.seq % n_dev_check:
         parser.error(
             f"--seq must be even (copy task halves) and divisible by "
@@ -55,10 +62,15 @@ def main():
     import optax
 
     from fiber_tpu.models import TinyLM, make_train_step
+    from fiber_tpu.parallel import default_mesh
 
+    # An explicit mesh makes every plane — flash included — shard the
+    # sequence; with mesh=None flash stays single-device.
+    mesh = default_mesh() if len(jax.devices()) > 1 else None
     model = TinyLM(vocab=args.vocab, dim=args.dim, heads=8,
                    layers=args.layers, max_seq=args.seq,
-                   attention=args.attention)
+                   mesh=mesh, attention=args.attention,
+                   kv_heads=args.kv_heads)
     params = model.init(jax.random.PRNGKey(0))
     opt = optax.adamw(args.lr, weight_decay=0.01)
     opt_state = opt.init(params)
@@ -82,9 +94,11 @@ def main():
         return l1.mean(), l2.mean()
 
     key = jax.random.PRNGKey(1)
-    n_dev = 1 if args.attention == "flash" else len(jax.devices())
-    plane = ("single device, kernels" if args.attention == "flash"
-             else f"{n_dev} devices ({args.seq // n_dev} tokens/device)")
+    n_dev = len(jax.devices())
+    shard = f"{n_dev} devices ({args.seq // n_dev} tokens/device)"
+    plane = (shard if args.attention != "flash"
+             else "single device, kernels" if n_dev == 1
+             else f"ring x flash kernels over {shard}")
     print(f"{args.attention} attention, seq {args.seq} over {plane}")
     for i in range(args.steps):
         key, k = jax.random.split(key)

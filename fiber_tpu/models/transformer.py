@@ -29,8 +29,9 @@ class TinyLM:
     ``heads % n_devices == 0``), ``"flash"`` (the Pallas
     flash-attention kernels, forward AND backward — single device runs
     them directly with the whole sequence in HBM and scores streamed
-    through VMEM; on a multi-device mesh the sequence shards over the
-    ring with the kernel as every rotation's per-device block), or
+    through VMEM; pass a multi-device ``mesh=`` and the sequence
+    shards over the ring with the kernel as every rotation's
+    per-device block), or
     ``"reference"`` (full score matrix, single device — for parity
     tests).
 
@@ -49,11 +50,21 @@ class TinyLM:
         mlp_mult: int = 4,
         mesh=None,
         attention: str = "ring",
+        kv_heads: Optional[int] = None,
     ) -> None:
         if dim % heads:
             raise ValueError(f"dim {dim} not divisible by heads {heads}")
         if attention not in ("ring", "ulysses", "flash", "reference"):
             raise ValueError(f"unknown attention {attention!r}")
+        if kv_heads is not None and kv_heads < 1:
+            # 0 must not silently mean "full MHA" (a GQA A/B would
+            # quietly measure nothing) and negatives pass Python's
+            # modulo only to crash deep inside init().
+            raise ValueError(f"kv_heads must be >= 1, got {kv_heads}")
+        kv_heads = kv_heads or heads
+        if heads % kv_heads:
+            raise ValueError(
+                f"heads {heads} not divisible by kv_heads {kv_heads}")
         self._flash_multi = False
         if mesh is not None:
             import numpy as np
@@ -76,6 +87,12 @@ class TinyLM:
         self.vocab = vocab
         self.dim = dim
         self.heads = heads
+        # kv_heads < heads is grouped-query attention: the flash plane
+        # reads the small KV natively (kernel index maps share KV
+        # blocks across each query group); the XLA planes broadcast KV
+        # to full heads at attend time (compute identical, memory not
+        # saved there — GQA's KV-cache/HBM win is a kernel property).
+        self.kv_heads = kv_heads
         self.head_dim = dim // heads
         self.layers = layers
         self.max_seq = max_seq
@@ -104,20 +121,37 @@ class TinyLM:
             keys = jax.random.split(key, 7)
             key = keys[6]
             d, h = self.dim, self.mlp_mult * self.dim
-            params["blocks"].append({
+            blk = {
                 "norm1": jnp.ones((d,)),
-                "wqkv": scale * jax.random.normal(keys[0], (d, 3 * d)),
                 "wo": scale * jax.random.normal(keys[1], (d, d)),
                 "norm2": jnp.ones((d,)),
                 "w1": scale * jax.random.normal(keys[2], (d, h)),
                 "b1": jnp.zeros((h,)),
                 "w2": scale * jax.random.normal(keys[3], (h, d)),
                 "b2": jnp.zeros((d,)),
-            })
+            }
+            if self.kv_heads == self.heads:
+                blk["wqkv"] = scale * jax.random.normal(
+                    keys[0], (d, 3 * d))
+            else:
+                kv_dim = self.kv_heads * self.head_dim
+                blk["wq"] = scale * jax.random.normal(keys[0], (d, d))
+                blk["wkv"] = scale * jax.random.normal(
+                    keys[4], (d, 2 * kv_dim))
+            params["blocks"].append(blk)
         return params
 
     # ------------------------------------------------------------------
     def _attend(self, q, k, v):
+        if k.shape[1] != q.shape[1] and self.attention != "flash":
+            # GQA on the XLA planes: broadcast KV to full heads (repeat
+            # order matches the kernel's ih // group sharing). Only the
+            # flash kernels read the small KV natively.
+            import jax.numpy as jnp
+
+            reps = q.shape[1] // k.shape[1]
+            k = jnp.repeat(k, reps, axis=1)
+            v = jnp.repeat(v, reps, axis=1)
         if self.attention == "reference":
             from fiber_tpu.ops.ring_attention import reference_attention
 
@@ -160,14 +194,22 @@ class TinyLM:
         import jax.numpy as jnp
 
         S, H, Dh = self.max_seq, self.heads, self.head_dim
+        KVH = self.kv_heads
         x = params["embed"][tokens] + params["pos"]          # (S, dim)
         for blk in params["blocks"]:
             h = self._rms(x, blk["norm1"])
-            qkv = h @ blk["wqkv"]                            # (S, 3*dim)
-            q, k, v = jnp.split(qkv, 3, axis=-1)
+            if KVH == H:
+                qkv = h @ blk["wqkv"]                        # (S, 3*dim)
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+                k = k.reshape(S, H, Dh)
+                v = v.reshape(S, H, Dh)
+            else:
+                q = h @ blk["wq"]                            # (S, dim)
+                kv = h @ blk["wkv"]                          # (S, 2*kvd)
+                k, v = jnp.split(kv, 2, axis=-1)
+                k = k.reshape(S, KVH, Dh)
+                v = v.reshape(S, KVH, Dh)
             q = q.reshape(S, H, Dh)
-            k = k.reshape(S, H, Dh)
-            v = v.reshape(S, H, Dh)
             attn = self._attend(q, k, v).reshape(S, -1)
             x = x + attn @ blk["wo"]
             h = self._rms(x, blk["norm2"])

@@ -185,16 +185,21 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
-                    block_kv: int, n_q: int, causal: bool, scale: float):
-    """Grid (heads, n_kv, n_q), q innermost: accumulate dk and dv for
-    one kv-block across the Q sweep."""
+                    block_kv: int, n_q: int, group: int, causal: bool,
+                    scale: float):
+    """Grid (kv_heads, n_kv, group, n_q), (group, q) innermost:
+    accumulate dk and dv for one kv-block across the Q sweep of EVERY
+    query head sharing that KV head (GQA: ``group`` query heads per KV
+    head; MHA is group == 1). The two inner grid axes keep each output
+    block's revisits contiguous — the TPU accumulation-grid rule."""
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     ik = pl.program_id(1)
-    iq = pl.program_id(2)
+    g = pl.program_id(2)
+    iq = pl.program_id(3)
 
-    @pl.when(iq == 0)
+    @pl.when((g == 0) & (iq == 0))
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -224,7 +229,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(iq == n_q - 1)
+    @pl.when((g == group - 1) & (iq == n_q - 1))
     def _finalize():
         dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -251,16 +256,25 @@ def _pick_block(s: int, want: int) -> int:
 def flash_attention(q, k, v, *, causal: bool = False,
                     block_q: int = 512, block_kv: int = 512,
                     interpret: bool = False):
-    """Exact attention, O(S) memory, differentiable. q, k, v:
-    (S, heads, head_dim); returns (S, heads, head_dim) in q's dtype.
+    """Exact attention, O(S) memory, differentiable. q:
+    (S, heads, head_dim); k, v: (S, kv_heads, head_dim) where kv_heads
+    divides heads — kv_heads < heads is grouped-query attention (each
+    group of heads/kv_heads query heads shares one KV head; the kernel
+    index maps do the sharing, so repeated KV never materializes).
+    Returns (S, heads, head_dim) in q's dtype.
 
     ``interpret=True`` runs the kernels in the Pallas interpreter
     (CPU-testable, slow) — used by the test suite; on TPU leave False.
     The compiled program is cached per (shape, dtype, flags).
     """
     fn = _build(q.shape, str(q.dtype), causal, block_q, block_kv,
-                interpret)
+                interpret, _kv_heads_of(q, k))
     return fn(q, k, v)
+
+
+def _kv_heads_of(q, k):
+    """None for plain MHA (cache-key stability), kv head count for GQA."""
+    return None if k.shape[1] == q.shape[1] else k.shape[1]
 
 
 def flash_attention_lse(q, k, v, *, causal: bool = False,
@@ -275,23 +289,35 @@ def flash_attention_lse(q, k, v, *, causal: bool = False,
     Differentiable in BOTH outputs: the lse cotangent enters the
     FlashAttention-2 backward as ``ds += dlse * p``, which folds into
     the existing delta term (``delta - dlse``) at zero extra kernel
-    cost.
+    cost. Supports GQA like :func:`flash_attention`.
     """
     fn = _build_lse(q.shape, str(q.dtype), causal, block_q, block_kv,
-                    interpret)
+                    interpret, _kv_heads_of(q, k))
     return fn(q, k, v)
 
 
 @functools.lru_cache(maxsize=64)
-def _build_calls(shape, dtype, causal, block_q, block_kv, interpret):
+def _build_calls(shape, dtype, causal, block_q, block_kv, interpret,
+                 kv_heads=None):
     """The three pallas_call programs (fwd, dq, dkv) for one config —
-    shared by the out-only and the (out, lse) entry points."""
+    shared by the out-only and the (out, lse) entry points.
+
+    ``kv_heads`` < heads enables grouped-query attention: K/V carry
+    kv_heads heads and every group of ``heads // kv_heads`` query heads
+    reads the same KV block (the index maps do the sharing — no
+    repeated KV ever materializes); dk/dv accumulate across the group
+    inside the kernel."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     s, h, d = shape
+    kvh = kv_heads or h
+    if kvh < 1 or h % kvh:
+        raise ValueError(
+            f"kv_heads {kvh} must be >= 1 and divide heads {h}")
+    group = h // kvh
     bq = _pick_block(s, block_q)
     bk = _pick_block(s, block_kv)
     n_q = s // bq
@@ -299,7 +325,8 @@ def _build_calls(shape, dtype, causal, block_q, block_kv, interpret):
     scale = 1.0 / (d ** 0.5)
 
     qkv_spec_q = pl.BlockSpec((1, bq, d), lambda ih, iq, ik: (ih, iq, 0))
-    qkv_spec_k = pl.BlockSpec((1, bk, d), lambda ih, iq, ik: (ih, ik, 0))
+    qkv_spec_k = pl.BlockSpec(
+        (1, bk, d), lambda ih, iq, ik: (ih // group, ik, 0))
     row_spec_q = pl.BlockSpec((1, bq), lambda ih, iq, ik: (ih, iq))
 
     fwd_call = pl.pallas_call(
@@ -330,19 +357,25 @@ def _build_calls(shape, dtype, causal, block_q, block_kv, interpret):
         interpret=interpret,
     )
 
-    # dkv grid is (h, n_kv, n_q): program ids land as (ih, ik, iq).
-    dkv_q_spec = pl.BlockSpec((1, bq, d), lambda ih, ik, iq: (ih, iq, 0))
-    dkv_k_spec = pl.BlockSpec((1, bk, d), lambda ih, ik, iq: (ih, ik, 0))
-    dkv_row_spec = pl.BlockSpec((1, bq), lambda ih, ik, iq: (ih, iq))
+    # dkv grid is (kv_heads, n_kv, group, n_q): program ids land as
+    # (ikv, ik, g, iq); (g, iq) innermost so each (ikv, ik) output
+    # block's revisits are contiguous.
+    dkv_q_spec = pl.BlockSpec(
+        (1, bq, d), lambda ikv, ik, g, iq: (ikv * group + g, iq, 0))
+    dkv_k_spec = pl.BlockSpec(
+        (1, bk, d), lambda ikv, ik, g, iq: (ikv, ik, 0))
+    dkv_row_spec = pl.BlockSpec(
+        (1, bq), lambda ikv, ik, g, iq: (ikv * group + g, iq))
     dkv_call = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=bq, block_kv=bk,
-                          n_q=n_q, causal=causal, scale=scale),
-        grid=(h, n_kv, n_q),
+                          n_q=n_q, group=group, causal=causal,
+                          scale=scale),
+        grid=(kvh, n_kv, group, n_q),
         in_specs=[dkv_q_spec, dkv_k_spec, dkv_k_spec, dkv_q_spec,
                   dkv_row_spec, dkv_row_spec],
         out_specs=[dkv_k_spec, dkv_k_spec],
-        out_shape=[jax.ShapeDtypeStruct((h, s, d), dtype),
-                   jax.ShapeDtypeStruct((h, s, d), dtype)],
+        out_shape=[jax.ShapeDtypeStruct((kvh, s, d), dtype),
+                   jax.ShapeDtypeStruct((kvh, s, d), dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
@@ -351,12 +384,12 @@ def _build_calls(shape, dtype, causal, block_q, block_kv, interpret):
 
 
 def _make_attn(shape, dtype, causal, block_q, block_kv, interpret,
-               with_lse: bool):
+               with_lse: bool, kv_heads=None):
     import jax
     import jax.numpy as jnp
 
     fwd_call, dq_call, dkv_call = _build_calls(
-        shape, dtype, causal, block_q, block_kv, interpret)
+        shape, dtype, causal, block_q, block_kv, interpret, kv_heads)
 
     def _fwd_core(q, k, v):
         """(S,H,D) API -> (H,S,D) kernels and back."""
@@ -414,15 +447,17 @@ def _make_attn(shape, dtype, causal, block_q, block_kv, interpret,
 
 
 @functools.lru_cache(maxsize=64)
-def _build(shape, dtype, causal, block_q, block_kv, interpret):
+def _build(shape, dtype, causal, block_q, block_kv, interpret,
+           kv_heads=None):
     return _make_attn(shape, dtype, causal, block_q, block_kv,
-                      interpret, with_lse=False)
+                      interpret, with_lse=False, kv_heads=kv_heads)
 
 
 @functools.lru_cache(maxsize=64)
-def _build_lse(shape, dtype, causal, block_q, block_kv, interpret):
+def _build_lse(shape, dtype, causal, block_q, block_kv, interpret,
+               kv_heads=None):
     return _make_attn(shape, dtype, causal, block_q, block_kv,
-                      interpret, with_lse=True)
+                      interpret, with_lse=True, kv_heads=kv_heads)
 
 
 def flash_available() -> bool:

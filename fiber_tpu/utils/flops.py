@@ -109,8 +109,15 @@ def tinylm_flops_per_step(model, seq: int, train: bool = True) -> float:
     Counts the per-block qkv/out/mlp matmuls, attention, and the
     unembedding projection; embeddings are lookups (0 matmul FLOPs)."""
     d, h = model.dim, model.mlp_mult * model.dim
+    kvh = getattr(model, "kv_heads", model.heads)
+    if kvh == model.heads:
+        proj = matmul_flops(seq, d, 3 * d)              # fused wqkv
+    else:
+        kv_dim = kvh * model.head_dim
+        proj = (matmul_flops(seq, d, d)                 # wq
+                + matmul_flops(seq, d, 2 * kv_dim))     # wkv
     per_block = (
-        matmul_flops(seq, d, 3 * d)     # wqkv
+        proj
         + matmul_flops(seq, d, d)       # wo
         + matmul_flops(seq, d, h)       # w1
         + matmul_flops(seq, h, d)       # w2

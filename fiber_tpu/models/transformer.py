@@ -188,33 +188,42 @@ class TinyLM:
         return g * x / jnp.sqrt(
             jnp.mean(x * x, axis=-1, keepdims=True) + 1e-6)
 
+    def _project_qkv(self, blk, h):
+        """Pre-attention projections, flat head layout. Works on (S,
+        dim) rows and single (dim,) vectors alike — SHARED by apply()
+        and _decode_step() so the block structure cannot silently
+        diverge between the training and decode paths."""
+        import jax.numpy as jnp
+
+        if self.kv_heads == self.heads:
+            return jnp.split(h @ blk["wqkv"], 3, axis=-1)
+        q = h @ blk["wq"]
+        k, v = jnp.split(h @ blk["wkv"], 2, axis=-1)
+        return q, k, v
+
+    def _block_tail(self, blk, x, attn_flat):
+        """Post-attention residual + MLP (shared like _project_qkv)."""
+        import jax
+
+        x = x + attn_flat @ blk["wo"]
+        h = self._rms(x, blk["norm2"])
+        return x + jax.nn.gelu(h @ blk["w1"] + blk["b1"]) @ blk["w2"] \
+            + blk["b2"]
+
     def apply(self, params, tokens):
         """tokens (max_seq,) int -> logits (max_seq, vocab)."""
-        import jax
-        import jax.numpy as jnp
 
         S, H, Dh = self.max_seq, self.heads, self.head_dim
         KVH = self.kv_heads
         x = params["embed"][tokens] + params["pos"]          # (S, dim)
         for blk in params["blocks"]:
             h = self._rms(x, blk["norm1"])
-            if KVH == H:
-                qkv = h @ blk["wqkv"]                        # (S, 3*dim)
-                q, k, v = jnp.split(qkv, 3, axis=-1)
-                k = k.reshape(S, H, Dh)
-                v = v.reshape(S, H, Dh)
-            else:
-                q = h @ blk["wq"]                            # (S, dim)
-                kv = h @ blk["wkv"]                          # (S, 2*kvd)
-                k, v = jnp.split(kv, 2, axis=-1)
-                k = k.reshape(S, KVH, Dh)
-                v = v.reshape(S, KVH, Dh)
+            q, k, v = self._project_qkv(blk, h)
             q = q.reshape(S, H, Dh)
+            k = k.reshape(S, KVH, Dh)
+            v = v.reshape(S, KVH, Dh)
             attn = self._attend(q, k, v).reshape(S, -1)
-            x = x + attn @ blk["wo"]
-            h = self._rms(x, blk["norm2"])
-            x = x + jax.nn.gelu(h @ blk["w1"] + blk["b1"]) @ blk["w2"] \
-                + blk["b2"]
+            x = self._block_tail(blk, x, attn)
         x = self._rms(x, params["final_norm"])
         return x @ params["out"]
 
@@ -228,6 +237,112 @@ class TinyLM:
         logp = jax.nn.log_softmax(logits, axis=-1)
         return -jnp.mean(
             jnp.take_along_axis(logp, targets[:, None], axis=1))
+
+    # ------------------------------------------------------------------
+    # Inference: autoregressive decode with per-layer KV caches.
+    # ------------------------------------------------------------------
+    def _decode_step(self, params, caches, pos, tok):
+        """One incremental position: returns (new_caches, logits).
+
+        caches: per block {"k": (S, kv_heads, Dh), "v": same} — only
+        rows [0, pos] are valid; this step writes row ``pos`` and
+        attends q against the masked cache. O(S) per step with static
+        shapes (jit/scan friendly), single device — decode is a
+        latency path, not a sharded-compute path.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        H, KVH, Dh = self.heads, self.kv_heads, self.head_dim
+        group = H // KVH
+        x = params["embed"][tok] + params["pos"][pos]        # (dim,)
+        new_caches = []
+        for blk, cache in zip(params["blocks"], caches):
+            h = self._rms(x, blk["norm1"])
+            q, k, v = self._project_qkv(blk, h)
+            q = q.reshape(KVH, group, Dh)
+            k_cache = cache["k"].at[pos].set(k.reshape(KVH, Dh))
+            v_cache = cache["v"].at[pos].set(v.reshape(KVH, Dh))
+            new_caches.append({"k": k_cache, "v": v_cache})
+            # (kvh, group, S) scores vs the whole cache, masked to
+            # positions <= pos; f32 softmax statistics as everywhere.
+            s = jnp.einsum("kgd,skd->kgs", q, k_cache,
+                           preferred_element_type=jnp.float32)
+            s = s / (Dh ** 0.5)
+            mask = jnp.arange(k_cache.shape[0]) <= pos
+            s = jnp.where(mask[None, None, :], s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum("kgs,skd->kgd", p.astype(v_cache.dtype),
+                              v_cache, preferred_element_type=jnp.float32)
+            x = self._block_tail(blk, x, attn.astype(x.dtype).reshape(-1))
+        x = self._rms(x, params["final_norm"])
+        return new_caches, x @ params["out"]
+
+    def generate(self, params, prompt, steps: int, key=None,
+                 temperature: float = 0.0):
+        """Decode ``steps`` tokens after ``prompt`` (1-D int array).
+        Greedy at temperature 0 (default); otherwise samples with
+        ``key``. Returns the (len(prompt) + steps,) token array. The
+        whole prefill + decode runs as two ``lax.scan``s over the
+        cached single-position step — one compiled program, no
+        per-token dispatch. len(prompt) + steps must be <= max_seq."""
+        import jax
+        import jax.numpy as jnp
+
+        prompt = jnp.asarray(prompt, jnp.int32)
+        n_prompt = int(prompt.shape[0])
+        if n_prompt < 1:
+            raise ValueError("prompt must have at least one token")
+        if n_prompt + steps > self.max_seq:
+            raise ValueError(
+                f"prompt ({n_prompt}) + steps ({steps}) exceeds "
+                f"max_seq ({self.max_seq})")
+        if temperature > 0.0 and key is None:
+            raise ValueError("sampling (temperature > 0) needs a key")
+        key = key if key is not None else jax.random.PRNGKey(0)
+
+        S, KVH, Dh = self.max_seq, self.kv_heads, self.head_dim
+        # Caches follow the params dtype — an f32 cache under bf16
+        # params would silently double the KV-cache footprint, the very
+        # memory GQA exists to save.
+        cdtype = params["embed"].dtype
+        caches = [
+            {"k": jnp.zeros((S, KVH, Dh), cdtype),
+             "v": jnp.zeros((S, KVH, Dh), cdtype)}
+            for _ in params["blocks"]
+        ]
+
+        def prefill(carry, inp):
+            caches = carry
+            pos, tok = inp
+            caches, logits = self._decode_step(params, caches, pos, tok)
+            return caches, logits
+
+        caches, logits_seq = jax.lax.scan(
+            prefill, caches, (jnp.arange(n_prompt), prompt))
+
+        def pick(logits, k):
+            if temperature > 0.0:
+                return jax.random.categorical(k, logits / temperature)
+            return jnp.argmax(logits).astype(jnp.int32)
+
+        def decode(carry, pos):
+            caches, tok, k = carry
+            k, k_step = jax.random.split(k)
+            caches, logits = self._decode_step(params, caches, pos, tok)
+            nxt = pick(logits, k_step).astype(jnp.int32)
+            return (caches, nxt, k), nxt
+
+        key, k_first = jax.random.split(key)  # use-once key discipline
+        first = pick(logits_seq[-1], k_first).astype(jnp.int32)
+        if steps <= 1:
+            out = first[None][:steps]
+        else:
+            (_, _, _), rest = jax.lax.scan(
+                decode, (caches, first, key),
+                jnp.arange(n_prompt, n_prompt + steps - 1))
+            out = jnp.concatenate([first[None], rest])
+        return jnp.concatenate([prompt, out])
 
 
 def make_train_step(model: TinyLM, optimizer, batched: bool = False):

@@ -33,9 +33,41 @@ _NEG_INF = -1e30  # large-negative instead of -inf: avoids inf-inf NaNs
 # ---------------------------------------------------------------------------
 
 
+def _run_window(iq, ik, block_q, block_kv, causal, window):
+    """Static-shape block-skip predicate: False when the (q-block,
+    kv-block) pair can contribute nothing — above the causal diagonal,
+    or (with a sliding window) entirely older than every q row's
+    window. Skipped blocks are what turn O(S^2) into O(S*window)."""
+    import jax.numpy as jnp
+
+    if not causal:
+        return jnp.bool_(True)
+    run = ik * block_kv < (iq + 1) * block_q
+    if window is not None:
+        # Block's newest kv index >= the oldest position any q row in
+        # this block may attend: (ik+1)*bk - 1 >= iq*bq - window + 1.
+        run = run & ((ik + 1) * block_kv > iq * block_q - window + 1)
+    return run
+
+
+def _keep_mask(iq, ik, block_q, block_kv, window):
+    """Elementwise causal(+window) keep mask for one score tile."""
+    import jax
+    import jax.numpy as jnp
+
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 0)
+    kv_pos = ik * block_kv + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 1)
+    keep = q_pos >= kv_pos
+    if window is not None:
+        keep = keep & (q_pos - kv_pos < window)
+    return keep
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
                 acc_ref, *, block_q: int, block_kv: int, n_kv: int,
-                causal: bool, scale: float):
+                causal: bool, scale: float, window=None):
     """One (head, q-block, kv-block) grid step.
 
     Grid = (heads, S/block_q, S/block_kv), kv innermost: the VMEM
@@ -57,12 +89,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # Causal: KV blocks strictly above the diagonal contribute nothing.
-    # (The BLOCK is skipped; the diagonal block masks elementwise.)
-    if causal:
-        run = ik * block_kv < (iq + 1) * block_q
-    else:
-        run = jnp.bool_(True)
+    # Causal: KV blocks strictly above the diagonal contribute nothing;
+    # a sliding window also skips blocks entirely older than the
+    # window. (Skipped BLOCKS; boundary blocks mask elementwise.)
+    run = _run_window(iq, ik, block_q, block_kv, causal, window)
 
     @pl.when(run)
     def _accumulate():
@@ -73,19 +103,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
+        keep = None
         if causal:
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 0)
-            kv_pos = ik * block_kv + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+            keep = _keep_mask(iq, ik, block_q, block_kv, window)
+            s = jnp.where(keep, s, _NEG_INF)
 
         m_prev = m_ref[:]                            # (block_q, 1)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)                       # (block_q, block_kv)
-        if causal:
-            p = jnp.where(q_pos >= kv_pos, p, 0.0)
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
         corr = jnp.exp(m_prev - m_new)               # (block_q, 1)
         l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
@@ -117,7 +145,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
 
 
 def _bwd_p_ds(q, k, v, do, lse, delta, iq, ik, *, block_q, block_kv,
-              causal, scale):
+              causal, scale, window=None):
     """Shared recompute: softmax weights p and score grads ds for one
     (q-block, kv-block) pair, all f32."""
     import jax
@@ -129,11 +157,8 @@ def _bwd_p_ds(q, k, v, do, lse, delta, iq, ik, *, block_q, block_kv,
     ) * scale
     p = jnp.exp(s - lse[:, None])                    # (bq, bkv)
     if causal:
-        q_pos = iq * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_kv), 0)
-        kv_pos = ik * block_kv + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_kv), 1)
-        p = jnp.where(q_pos >= kv_pos, p, 0.0)
+        p = jnp.where(_keep_mask(iq, ik, block_q, block_kv, window),
+                      p, 0.0)
     dp = jax.lax.dot_general(                        # do @ v^T
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -144,7 +169,7 @@ def _bwd_p_ds(q, k, v, do, lse, delta, iq, ik, *, block_q, block_kv,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc, *, block_q: int, block_kv: int,
-                   n_kv: int, causal: bool, scale: float):
+                   n_kv: int, causal: bool, scale: float, window=None):
     """Grid (heads, n_q, n_kv), kv innermost: accumulate dq for one
     q-block across the KV sweep."""
     import jax.numpy as jnp
@@ -157,10 +182,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    if causal:
-        run = ik * block_kv < (iq + 1) * block_q
-    else:
-        run = jnp.bool_(True)
+    run = _run_window(iq, ik, block_q, block_kv, causal, window)
 
     @pl.when(run)
     def _accumulate():
@@ -172,7 +194,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0].astype(jnp.float32)
         _, ds = _bwd_p_ds(q, k, v, do, lse_ref[0], delta_ref[0], iq, ik,
                           block_q=block_q, block_kv=block_kv,
-                          causal=causal, scale=scale)
+                          causal=causal, scale=scale, window=window)
         dq_acc[:] += jax.lax.dot_general(            # ds @ k
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -186,7 +208,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
                     block_kv: int, n_q: int, group: int, causal: bool,
-                    scale: float):
+                    scale: float, window=None):
     """Grid (kv_heads, n_kv, group, n_q), (group, q) innermost:
     accumulate dk and dv for one kv-block across the Q sweep of EVERY
     query head sharing that KV head (GQA: ``group`` query heads per KV
@@ -204,10 +226,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    if causal:
-        run = ik * block_kv < (iq + 1) * block_q
-    else:
-        run = jnp.bool_(True)
+    run = _run_window(iq, ik, block_q, block_kv, causal, window)
 
     @pl.when(run)
     def _accumulate():
@@ -219,7 +238,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0].astype(jnp.float32)
         p, ds = _bwd_p_ds(q, k, v, do, lse_ref[0], delta_ref[0], iq, ik,
                           block_q=block_q, block_kv=block_kv,
-                          causal=causal, scale=scale)
+                          causal=causal, scale=scale, window=window)
         dv_acc[:] += jax.lax.dot_general(            # p^T @ do
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -255,7 +274,7 @@ def _pick_block(s: int, want: int) -> int:
 
 def flash_attention(q, k, v, *, causal: bool = False,
                     block_q: int = 512, block_kv: int = 512,
-                    interpret: bool = False):
+                    interpret: bool = False, window=None):
     """Exact attention, O(S) memory, differentiable. q:
     (S, heads, head_dim); k, v: (S, kv_heads, head_dim) where kv_heads
     divides heads — kv_heads < heads is grouped-query attention (each
@@ -263,12 +282,18 @@ def flash_attention(q, k, v, *, causal: bool = False,
     index maps do the sharing, so repeated KV never materializes).
     Returns (S, heads, head_dim) in q's dtype.
 
+    ``window`` (requires ``causal=True``) restricts every position to
+    the last ``window`` tokens (self included): KV blocks entirely
+    outside the window are skipped at the grid level, so compute drops
+    from O(S^2) to O(S*window) — the standard local-attention layer of
+    sliding-window transformers. Composes with GQA.
+
     ``interpret=True`` runs the kernels in the Pallas interpreter
     (CPU-testable, slow) — used by the test suite; on TPU leave False.
     The compiled program is cached per (shape, dtype, flags).
     """
     fn = _build(q.shape, str(q.dtype), causal, block_q, block_kv,
-                interpret, _kv_heads_of(q, k))
+                interpret, _kv_heads_of(q, k), window)
     return fn(q, k, v)
 
 
@@ -279,7 +304,7 @@ def _kv_heads_of(q, k):
 
 def flash_attention_lse(q, k, v, *, causal: bool = False,
                         block_q: int = 512, block_kv: int = 512,
-                        interpret: bool = False):
+                        interpret: bool = False, window=None):
     """Like :func:`flash_attention` but also returns the per-row
     logsumexp ``(heads, S) float32`` — the residual that makes partial
     attentions MERGEABLE (ring composition:
@@ -289,18 +314,25 @@ def flash_attention_lse(q, k, v, *, causal: bool = False,
     Differentiable in BOTH outputs: the lse cotangent enters the
     FlashAttention-2 backward as ``ds += dlse * p``, which folds into
     the existing delta term (``delta - dlse``) at zero extra kernel
-    cost. Supports GQA like :func:`flash_attention`.
+    cost. Supports GQA and ``window`` like :func:`flash_attention` —
+    but note that with a window the lse is the WINDOWED logsumexp, so
+    merging partials is only exact over KV sets that respect the same
+    window (the ring composition does not pass a window).
     """
     fn = _build_lse(q.shape, str(q.dtype), causal, block_q, block_kv,
-                    interpret, _kv_heads_of(q, k))
+                    interpret, _kv_heads_of(q, k), window)
     return fn(q, k, v)
 
 
 @functools.lru_cache(maxsize=64)
 def _build_calls(shape, dtype, causal, block_q, block_kv, interpret,
-                 kv_heads=None):
+                 kv_heads=None, window=None):
     """The three pallas_call programs (fwd, dq, dkv) for one config —
     shared by the out-only and the (out, lse) entry points.
+
+    ``window`` (causal only) restricts attention to the last
+    ``window`` positions — whole KV blocks outside every q row's
+    window are SKIPPED, turning O(S^2) into O(S*window).
 
     ``kv_heads`` < heads enables grouped-query attention: K/V carry
     kv_heads heads and every group of ``heads // kv_heads`` query heads
@@ -318,6 +350,10 @@ def _build_calls(shape, dtype, causal, block_q, block_kv, interpret,
         raise ValueError(
             f"kv_heads {kvh} must be >= 1 and divide heads {h}")
     group = h // kvh
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     bq = _pick_block(s, block_q)
     bk = _pick_block(s, block_kv)
     n_q = s // bq
@@ -331,7 +367,8 @@ def _build_calls(shape, dtype, causal, block_q, block_kv, interpret,
 
     fwd_call = pl.pallas_call(
         functools.partial(_fwd_kernel, block_q=bq, block_kv=bk,
-                          n_kv=n_kv, causal=causal, scale=scale),
+                          n_kv=n_kv, causal=causal, scale=scale,
+                          window=window),
         grid=(h, n_q, n_kv),
         in_specs=[qkv_spec_q, qkv_spec_k, qkv_spec_k],
         out_specs=[qkv_spec_q, row_spec_q],
@@ -347,7 +384,8 @@ def _build_calls(shape, dtype, causal, block_q, block_kv, interpret,
 
     dq_call = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=bq, block_kv=bk,
-                          n_kv=n_kv, causal=causal, scale=scale),
+                          n_kv=n_kv, causal=causal, scale=scale,
+                          window=window),
         grid=(h, n_q, n_kv),
         in_specs=[qkv_spec_q, qkv_spec_k, qkv_spec_k, qkv_spec_q,
                   row_spec_q, row_spec_q],
@@ -369,7 +407,7 @@ def _build_calls(shape, dtype, causal, block_q, block_kv, interpret,
     dkv_call = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=bq, block_kv=bk,
                           n_q=n_q, group=group, causal=causal,
-                          scale=scale),
+                          scale=scale, window=window),
         grid=(kvh, n_kv, group, n_q),
         in_specs=[dkv_q_spec, dkv_k_spec, dkv_k_spec, dkv_q_spec,
                   dkv_row_spec, dkv_row_spec],
@@ -384,12 +422,13 @@ def _build_calls(shape, dtype, causal, block_q, block_kv, interpret,
 
 
 def _make_attn(shape, dtype, causal, block_q, block_kv, interpret,
-               with_lse: bool, kv_heads=None):
+               with_lse: bool, kv_heads=None, window=None):
     import jax
     import jax.numpy as jnp
 
     fwd_call, dq_call, dkv_call = _build_calls(
-        shape, dtype, causal, block_q, block_kv, interpret, kv_heads)
+        shape, dtype, causal, block_q, block_kv, interpret, kv_heads,
+        window)
 
     def _fwd_core(q, k, v):
         """(S,H,D) API -> (H,S,D) kernels and back."""
@@ -448,16 +487,18 @@ def _make_attn(shape, dtype, causal, block_q, block_kv, interpret,
 
 @functools.lru_cache(maxsize=64)
 def _build(shape, dtype, causal, block_q, block_kv, interpret,
-           kv_heads=None):
+           kv_heads=None, window=None):
     return _make_attn(shape, dtype, causal, block_q, block_kv,
-                      interpret, with_lse=False, kv_heads=kv_heads)
+                      interpret, with_lse=False, kv_heads=kv_heads,
+                      window=window)
 
 
 @functools.lru_cache(maxsize=64)
 def _build_lse(shape, dtype, causal, block_q, block_kv, interpret,
-               kv_heads=None):
+               kv_heads=None, window=None):
     return _make_attn(shape, dtype, causal, block_q, block_kv,
-                      interpret, with_lse=True, kv_heads=kv_heads)
+                      interpret, with_lse=True, kv_heads=kv_heads,
+                      window=window)
 
 
 def flash_available() -> bool:

@@ -519,3 +519,76 @@ def test_generate_kv_cache_matches_full_apply():
         model.generate(params, prompt, steps=2, temperature=0.5)
     with pytest.raises(ValueError, match="exceeds"):
         model.generate(params, prompt, steps=64)
+
+
+def _windowed_reference(q, k, v, window):
+    """Causal sliding-window attention via explicit masking."""
+    d = q.shape[-1]
+    s = jnp.einsum("qhd,khd->hqk", q, k) / jnp.sqrt(
+        jnp.asarray(d, q.dtype))
+    sq = q.shape[0]
+    qpos = jnp.arange(sq)[:, None]
+    kpos = jnp.arange(sq)[None, :]
+    keep = (qpos >= kpos) & (qpos - kpos < window)
+    s = jnp.where(keep[None], s, jnp.finfo(s.dtype).min)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("hqk,khd->qhd", p, v)
+
+
+@pytest.mark.parametrize("window", [64, 100, 256])
+def test_flash_sliding_window_matches_reference(window):
+    """window= restricts attention to the last `window` positions;
+    block-aligned (64), unaligned (100), and wider-than-one-block (256)
+    windows must all match explicit masking — the block-skip predicate
+    AND the elementwise boundary mask are both load-bearing."""
+    q, k, v = _rand_qkv(512, 2, 32)
+    got = np.asarray(flash_attention(
+        q, k, v, causal=True, window=window, block_q=128, block_kv=128,
+        interpret=True))
+    want = np.asarray(_windowed_reference(q, k, v, window))
+    assert np.abs(got - want).max() < 2e-5
+
+
+def test_flash_sliding_window_gradients():
+    """Windowed backward: dq/dk/dv match differentiating the explicit
+    mask (the skip predicate must not drop boundary contributions)."""
+    q, k, v = _rand_qkv(384, 2, 32)
+    window = 100
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=True, window=window,
+                            block_q=128, block_kv=128, interpret=True)
+        return jnp.sum(o ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_windowed_reference(q, k, v, window) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        rel = float(jnp.abs(a - b).max() / (jnp.abs(b).max() + 1e-9))
+        assert rel < 1e-4, rel
+
+
+def test_flash_window_validation():
+    q, k, v = _rand_qkv(256, 2, 32)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, causal=False, window=64,
+                        interpret=True)
+    with pytest.raises(ValueError, match="window"):
+        flash_attention(q, k, v, causal=True, window=0, interpret=True)
+
+
+def test_flash_window_with_gqa():
+    """Sliding window composes with grouped-query attention."""
+    S, H, KVH, D = 256, 4, 2, 32
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(13), 3)
+    q = jax.random.normal(kq, (S, H, D))
+    k = jax.random.normal(kk, (S, KVH, D))
+    v = jax.random.normal(kv, (S, KVH, D))
+    got = np.asarray(flash_attention(
+        q, k, v, causal=True, window=96, block_q=128, block_kv=128,
+        interpret=True))
+    want = np.asarray(_windowed_reference(
+        q, jnp.repeat(k, 2, axis=1), jnp.repeat(v, 2, axis=1), 96))
+    assert np.abs(got - want).max() < 2e-5

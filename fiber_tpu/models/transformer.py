@@ -35,6 +35,11 @@ class TinyLM:
     ``"reference"`` (full score matrix, single device — for parity
     tests).
 
+    ``pos`` picks the positional scheme: ``"learned"`` (absolute
+    table, the default) or ``"rope"`` (rotary embeddings on q/k per
+    layer — relative positions, the modern long-context choice; no
+    position table in the params).
+
     ``apply(params, tokens (S,)) -> (S, vocab)`` logits;
     ``loss(params, tokens)`` is mean next-token cross-entropy.
     ``S`` must equal ``max_seq`` (static shapes; pad shorter text).
@@ -51,11 +56,16 @@ class TinyLM:
         mesh=None,
         attention: str = "ring",
         kv_heads: Optional[int] = None,
+        pos: str = "learned",
     ) -> None:
         if dim % heads:
             raise ValueError(f"dim {dim} not divisible by heads {heads}")
         if attention not in ("ring", "ulysses", "flash", "reference"):
             raise ValueError(f"unknown attention {attention!r}")
+        if pos not in ("learned", "rope"):
+            raise ValueError(f"unknown positional scheme {pos!r}")
+        if pos == "rope" and (dim // heads) % 2:
+            raise ValueError("rope needs an even head_dim")
         if kv_heads is not None and kv_heads < 1:
             # 0 must not silently mean "full MHA" (a GQA A/B would
             # quietly measure nothing) and negatives pass Python's
@@ -98,6 +108,11 @@ class TinyLM:
         self.max_seq = max_seq
         self.mlp_mult = mlp_mult
         self.attention = attention
+        # "learned": absolute position table added to embeddings.
+        # "rope": rotary embeddings applied to q/k per attention layer
+        # (relative positions; the modern long-context default — decays
+        # gracefully past training lengths where a learned table ends).
+        self.pos = pos
         self._mesh = mesh
 
     # ------------------------------------------------------------------
@@ -110,13 +125,14 @@ class TinyLM:
         params = {
             "embed": scale * jax.random.normal(
                 k_emb, (self.vocab, self.dim)),
-            "pos": scale * jax.random.normal(
-                k_pos, (self.max_seq, self.dim)),
             "out": scale * jax.random.normal(
                 k_out, (self.dim, self.vocab)),
             "final_norm": jnp.ones((self.dim,)),
             "blocks": [],
         }
+        if self.pos == "learned":
+            params["pos"] = scale * jax.random.normal(
+                k_pos, (self.max_seq, self.dim))
         for _ in range(self.layers):
             keys = jax.random.split(key, 7)
             key = keys[6]
@@ -201,6 +217,30 @@ class TinyLM:
         k, v = jnp.split(h @ blk["wkv"], 2, axis=-1)
         return q, k, v
 
+    @staticmethod
+    def _rope_angles(positions, dh):
+        """cos/sin tables for rotary embeddings at ``positions``
+        (scalar or (S,)): shape (..., dh/2), base 10000."""
+        import jax.numpy as jnp
+
+        inv = 1.0 / (10000.0 ** (jnp.arange(0, dh, 2) / dh))
+        ang = jnp.asarray(positions, jnp.float32)[..., None] * inv
+        return jnp.cos(ang), jnp.sin(ang)
+
+    @staticmethod
+    def _rope_rotate(x, cos, sin):
+        """Rotate feature pairs (half-split convention); cos/sin
+        broadcast against x's leading axes. The result keeps x's dtype:
+        f32 cos/sin must not silently promote a bf16 stream (which
+        would also let decode's cache cast rotated keys back DOWN,
+        drifting incremental decode away from full-apply)."""
+        import jax.numpy as jnp
+
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        return jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+            axis=-1).astype(x.dtype)
+
     def _block_tail(self, blk, x, attn_flat):
         """Post-attention residual + MLP (shared like _project_qkv)."""
         import jax
@@ -212,16 +252,26 @@ class TinyLM:
 
     def apply(self, params, tokens):
         """tokens (max_seq,) int -> logits (max_seq, vocab)."""
+        import jax.numpy as jnp
 
         S, H, Dh = self.max_seq, self.heads, self.head_dim
         KVH = self.kv_heads
-        x = params["embed"][tokens] + params["pos"]          # (S, dim)
+        x = params["embed"][tokens]                          # (S, dim)
+        rope = None
+        if self.pos == "learned":
+            x = x + params["pos"]
+        else:
+            cos, sin = self._rope_angles(jnp.arange(S), Dh)  # (S, dh/2)
+            rope = (cos[:, None, :], sin[:, None, :])
         for blk in params["blocks"]:
             h = self._rms(x, blk["norm1"])
             q, k, v = self._project_qkv(blk, h)
             q = q.reshape(S, H, Dh)
             k = k.reshape(S, KVH, Dh)
             v = v.reshape(S, KVH, Dh)
+            if rope is not None:
+                q = self._rope_rotate(q, *rope)
+                k = self._rope_rotate(k, *rope)
             attn = self._attend(q, k, v).reshape(S, -1)
             x = self._block_tail(blk, x, attn)
         x = self._rms(x, params["final_norm"])
@@ -255,13 +305,24 @@ class TinyLM:
 
         H, KVH, Dh = self.heads, self.kv_heads, self.head_dim
         group = H // KVH
-        x = params["embed"][tok] + params["pos"][pos]        # (dim,)
+        x = params["embed"][tok]                             # (dim,)
+        rope = None
+        if self.pos == "learned":
+            x = x + params["pos"][pos]
+        else:
+            rope = self._rope_angles(pos, Dh)                # (dh/2,)
         new_caches = []
         for blk, cache in zip(params["blocks"], caches):
             h = self._rms(x, blk["norm1"])
             q, k, v = self._project_qkv(blk, h)
             q = q.reshape(KVH, group, Dh)
-            k_cache = cache["k"].at[pos].set(k.reshape(KVH, Dh))
+            k = k.reshape(KVH, Dh)
+            if rope is not None:
+                # Rotate q and k at THIS position; the cache stores
+                # post-rotation keys (standard RoPE decode).
+                q = self._rope_rotate(q, *rope)
+                k = self._rope_rotate(k, *rope)
+            k_cache = cache["k"].at[pos].set(k)
             v_cache = cache["v"].at[pos].set(v.reshape(KVH, Dh))
             new_caches.append({"k": k_cache, "v": v_cache})
             # (kvh, group, S) scores vs the whole cache, masked to

@@ -57,6 +57,7 @@ class TinyLM:
         attention: str = "ring",
         kv_heads: Optional[int] = None,
         pos: str = "learned",
+        window: Optional[int] = None,
     ) -> None:
         if dim % heads:
             raise ValueError(f"dim {dim} not divisible by heads {heads}")
@@ -66,6 +67,16 @@ class TinyLM:
             raise ValueError(f"unknown positional scheme {pos!r}")
         if pos == "rope" and (dim // heads) % 2:
             raise ValueError("rope needs an even head_dim")
+        if window is not None:
+            if window < 1:
+                raise ValueError(f"window must be >= 1, got {window}")
+            if attention != "flash":
+                # The window lives in the flash kernels' block-skip
+                # grid; the XLA planes have no windowed engine and
+                # silently ignoring it would train a different model.
+                raise ValueError(
+                    "window= needs attention='flash' (the sliding "
+                    "window is a kernel feature)")
         if kv_heads is not None and kv_heads < 1:
             # 0 must not silently mean "full MHA" (a GQA A/B would
             # quietly measure nothing) and negatives pass Python's
@@ -94,6 +105,10 @@ class TinyLM:
                 # over the mesh AND every rotation streams scores
                 # through VMEM (ring_attention local="flash").
                 self._flash_multi = True
+        if window is not None and self._flash_multi:
+            raise ValueError(
+                "window= is single-device (a windowed partial's lse "
+                "is not ring-mergeable); drop the mesh or the window")
         self.vocab = vocab
         self.dim = dim
         self.heads = heads
@@ -113,6 +128,8 @@ class TinyLM:
         # (relative positions; the modern long-context default — decays
         # gracefully past training lengths where a learned table ends).
         self.pos = pos
+        #: causal sliding window (flash plane only; None = full causal)
+        self.window = window
         self._mesh = mesh
 
     # ------------------------------------------------------------------
@@ -187,6 +204,7 @@ class TinyLM:
                     q, k, v, mesh=self._mesh, causal=True,
                     local="flash", interpret=not flash_available())
             return flash_attention(q, k, v, causal=True,
+                                   window=self.window,
                                    interpret=not flash_available())
         if self.attention == "ulysses":
             from fiber_tpu.ops.ulysses_attention import ulysses_attention
@@ -330,7 +348,12 @@ class TinyLM:
             s = jnp.einsum("kgd,skd->kgs", q, k_cache,
                            preferred_element_type=jnp.float32)
             s = s / (Dh ** 0.5)
-            mask = jnp.arange(k_cache.shape[0]) <= pos
+            kv_pos = jnp.arange(k_cache.shape[0])
+            mask = kv_pos <= pos
+            if self.window is not None:
+                # A windowed model must decode windowed, or inference
+                # silently runs a different model than training.
+                mask = mask & (kv_pos > pos - self.window)
             s = jnp.where(mask[None, None, :], s, -jnp.inf)
             p = jax.nn.softmax(s, axis=-1)
             attn = jnp.einsum("kgs,skd->kgd", p.astype(v_cache.dtype),

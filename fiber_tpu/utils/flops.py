@@ -96,8 +96,22 @@ def matmul_flops(m: int, k: int, n: int) -> float:
 
 
 def attention_flops(seq: int, heads: int, head_dim: int,
-                    causal: bool = True, train: bool = False) -> float:
-    """QK^T + P.V for one head stack at full sequence length."""
+                    causal: bool = True, train: bool = False,
+                    window: Optional[int] = None) -> float:
+    """QK^T + P.V for one head stack at full sequence length. With a
+    causal sliding ``window`` each position attends min(pos+1, window)
+    keys instead of pos+1."""
+    if window is not None:
+        if not causal:
+            # Mirrors the kernel contract (window requires causal) —
+            # silently returning the causal count would deflate a
+            # non-causal figure by ~2x.
+            raise ValueError("windowed attention_flops requires causal")
+        w = min(window, seq)
+        # ramp-up prefix (positions 0..w-1 attend pos+1) + steady state
+        kv_total = w * (w + 1) / 2 + (seq - w) * w
+        fwd = 2 * 2 * kv_total * head_dim * heads
+        return fwd * (3.0 if train else 1.0)
     fwd = 2 * matmul_flops(seq, head_dim, seq) * heads
     if causal:
         fwd /= 2

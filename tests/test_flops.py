@@ -94,3 +94,20 @@ def test_peak_table_lookup(monkeypatch):
     assert flops.device_peak_flops(FakeDev("TPU v6e")) == 918e12
     # Unknown TPU generation: no peak, mfu stays None (not wrong).
     assert flops.device_peak_flops(FakeDev("TPU v99")) is None
+
+
+def test_windowed_attention_flops():
+    """Windowed FLOPs: ramp-up prefix + steady state, never more than
+    full causal, linear in window for seq >> window."""
+    s, h, d = 4096, 4, 64
+    full = flops.attention_flops(s, h, d, causal=True)
+    w256 = flops.attention_flops(s, h, d, causal=True, window=256)
+    w512 = flops.attention_flops(s, h, d, causal=True, window=512)
+    assert w256 < w512 < full
+    # exact hand count at window=256: 256*257/2 ramp + (4096-256)*256
+    kv = 256 * 257 / 2 + (4096 - 256) * 256
+    assert w256 == 2 * 2 * kv * d * h
+    # window >= seq degrades to full causal (the windowed count is the
+    # exact s(s+1)/2 sum; the legacy causal formula approximates s^2/2)
+    w_full = flops.attention_flops(s, h, d, causal=True, window=s)
+    assert abs(w_full / full - 1) < 1e-3

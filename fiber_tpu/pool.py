@@ -241,6 +241,14 @@ class ResultStore:
         with self._cond:
             return sum(e.remaining for e in self._entries.values())
 
+    def is_done(self, seq: int) -> bool:
+        """True when ``seq`` has completed or failed — its chunks are
+        dead weight and must not be handed to (or resubmitted at)
+        workers."""
+        with self._cond:
+            entry = self._entries.get(seq)
+            return entry is None or entry.remaining == 0
+
     def abort_all(self, exc: BaseException,
                   reason: str = "pool terminated",
                   direct: bool = False) -> None:
@@ -1267,6 +1275,16 @@ class Pool:
             pass
 
 
+class PoisonChunkError(Exception):
+    """One chunk killed every worker that received it (e.g. its payload
+    cannot deserialize in the worker); the map fails instead of
+    crash-looping the pool forever."""
+
+
+#: Consecutive death-resubmissions of ONE chunk before its map fails.
+_POISON_CAP = 8
+
+
 class ResilientPool(Pool):
     """REQ/REP pool with a pending table and resubmission on worker death
     (reference ResilientZPool, fiber/pool.py:1425-1688) — the default
@@ -1281,6 +1299,16 @@ class ResilientPool(Pool):
         #: here (single-writer: the task loop) so result/submit paths
         #: can skip the wake nudge when nothing is waiting on a gate.
         self._parked_count = 0
+        #: (seq, base) -> how many workers died holding that chunk; a
+        #: chunk that keeps killing workers is POISON (e.g. its payload
+        #: cannot deserialize in the worker) and must fail the map
+        #: rather than crash-loop the pool forever.
+        self._chunk_deaths: Dict[Tuple[int, int], int] = {}
+        #: seq -> consecutive worker deaths attributed to that map with
+        #: NO completed chunk in between (any result resets it). Catches
+        #: the every-chunk-is-poison map, where per-chunk counts spread
+        #: across the whole map and would take chunks*cap deaths to fire.
+        self._seq_deaths: Dict[int, int] = {}
         self._pid_to_idents: Dict[int, set] = {}
         self._reaped_pids: set = set()
         # Dead-ident guard against stale "ready"s queued before a
@@ -1403,6 +1431,11 @@ class ResilientPool(Pool):
                     continue
                 if item is None:
                     return
+                if self._store.is_done(item[1][0]):
+                    # Leftover chunk of a completed/poison-failed map:
+                    # handing it out would burn workers on a map whose
+                    # error already surfaced.
+                    item = None
             payload, key = item
             with self._pending_lock:
                 # The worker may have been reaped while we waited for a
@@ -1487,6 +1520,11 @@ class ResilientPool(Pool):
             table = self._pending.get(ident)
             if table is not None:
                 table.pop((seq, base), None)
+            # Completed chunks can't be poison; drop any death count so
+            # the table stays bounded by in-flight chunks. Progress on
+            # a map also clears its no-progress death streak.
+            self._chunk_deaths.pop((seq, base), None)
+            self._seq_deaths.pop(seq, None)
         # A completed chunk can clear a parked request's gate (the
         # requester is now idle) — nudge the handout loop instead of
         # letting it notice at its next recv timeout. Skipped entirely
@@ -1509,10 +1547,62 @@ class ResilientPool(Pool):
             table = self._pending.pop(ident, {})
             for idents in self._pid_to_idents.values():
                 idents.discard(ident)
-            resubmit = [(payload, key) for key, payload in table.items()]
+            resubmit = []
+            poisoned = []
+            # Death attribution is a heuristic: the OLDEST held chunk
+            # (handout order = dict insertion order) is the one being
+            # executed — or the only one, when a payload dies during
+            # decode. Younger staged chunks are bystanders and are NOT
+            # counted; a staged decode-poison gets counted on a later
+            # cycle when it lands first. A false positive needs one
+            # innocent chunk to be the oldest across CAP+1 consecutive
+            # deaths without ever completing — and the error is direct
+            # and catchable either way.
+            counted = False
+            for key, payload in table.items():
+                if self._store.is_done(key[0]):
+                    # Leftover of a completed/already-failed map: no
+                    # counting (no result will ever pop the entries)
+                    # and no resubmission.
+                    self._chunk_deaths.pop(key, None)
+                    self._seq_deaths.pop(key[0], None)
+                    continue
+                if not counted:
+                    counted = True
+                    deaths = self._chunk_deaths.get(key, 0) + 1
+                    self._chunk_deaths[key] = deaths
+                    seq_deaths = self._seq_deaths.get(key[0], 0) + 1
+                    self._seq_deaths[key[0]] = seq_deaths
+                    if (deaths > _POISON_CAP
+                            or seq_deaths > 3 * _POISON_CAP):
+                        poisoned.append(key)
+                        self._chunk_deaths.pop(key, None)
+                        self._seq_deaths.pop(key[0], None)
+                        continue
+                resubmit.append((payload, key))
+        # Fail poisoned maps BEFORE requeueing, so this very call's
+        # bystander chunks of a just-poisoned seq are dropped by the
+        # is_done filter instead of burning further workers.
+        for seq, base in poisoned:
+            logger.error(
+                "map seq=%d is killing workers without progress "
+                "(latest culprit chunk base=%d) — failing it as poison",
+                seq, base)
+            self._store.fail(
+                seq,
+                PoisonChunkError(
+                    f"map chunks keep killing workers with no progress "
+                    f"(last culprit at base {base}; does the task "
+                    "function/payload deserialize and run in the "
+                    "worker?)"),
+                reason="poison chunk", direct=True)
+        requeued = 0
         for payload, key in resubmit:
+            if self._store.is_done(key[0]):
+                continue  # e.g. failed by this call's poison path
             self._taskq.put((payload, key))
-        return len(resubmit)
+            requeued += 1
+        return requeued
 
     def _on_subworker_death(self, ident: bytes) -> None:
         """Resubmit one crashed sub-worker's pending chunks while its job
@@ -1531,22 +1621,17 @@ class ResilientPool(Pool):
 
     def _on_worker_death(self, proc) -> None:
         """Resubmit everything the dead worker still owed
-        (reference: fiber/pool.py:1612-1659)."""
+        (reference: fiber/pool.py:1612-1659) — through the same
+        poison-counting reclaim as sub-worker death, so a chunk that
+        kills whole workers escalates identically."""
         pid = proc.pid
         with self._pending_lock:
             self._reaped_pids.add(pid)
             idents = self._pid_to_idents.pop(pid, set())
-            resubmit: List[Tuple[bytes, Tuple[int, int]]] = []
-            for ident in idents:
-                table = self._pending.pop(ident, {})
-                resubmit.extend(
-                    (payload, key) for key, payload in table.items()
-                )
-        for payload, key in resubmit:
-            self._taskq.put((payload, key))
-        if resubmit:
+        n = sum(self._reclaim_ident(ident) for ident in idents)
+        if n:
             logger.info(
                 "resubmitted %d chunks from dead worker %s",
-                len(resubmit), proc.name,
+                n, proc.name,
             )
 

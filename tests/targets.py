@@ -376,3 +376,17 @@ def interlocked_queue_worker(args):
         if ins == "QUIT":
             return i
         returns.put(("ACK", i))
+
+
+def _explode_on_load():
+    raise RuntimeError("poison payload refused to deserialize")
+
+
+class PoisonOnLoad:
+    """Pickles fine on the master, raises on UNpickling — lands in the
+    worker's task-decode path and kills the process, modeling any
+    payload that can never deserialize remotely (version skew,
+    un-importable __main__, corrupted blob)."""
+
+    def __reduce__(self):
+        return (_explode_on_load, ())

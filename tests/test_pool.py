@@ -379,3 +379,23 @@ def test_maxtasksperchild_restarts_workers():
     with fiber_tpu.Pool(2, maxtasksperchild=2) as pool:
         results = pool.map(targets.square, range(40), chunksize=2)
         assert results == [i * i for i in range(40)]
+
+
+def test_poison_chunk_fails_map_instead_of_crash_looping():
+    """A chunk that kills EVERY worker that receives it (payload raises
+    on deserialization) must fail the map with a catchable error after
+    a bounded number of resubmissions — not crash-loop the pool
+    forever burning a worker per retry (round-4 soak finding)."""
+    from fiber_tpu.pool import PoisonChunkError
+
+    with fiber_tpu.Pool(2) as pool:
+        res = pool.map_async(
+            targets.identity,
+            [targets.PoisonOnLoad()], chunksize=1,
+        )
+        with pytest.raises(PoisonChunkError, match="deserialize"):
+            res.get(timeout=240)
+        # The pool is still alive for healthy work afterwards.
+        assert pool.map(targets.square, range(8)) == [
+            i * i for i in range(8)
+        ]

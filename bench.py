@@ -359,6 +359,7 @@ def main() -> int:
         "env_steps_per_sec": round(evals_per_sec * args.steps, 1),
         "model_flops_per_sec": round(model_fps, 1),
         "mfu": _round_mfu(flopsmod.mfu(model_fps, devices)),
+        **flopsmod.peak_report(devices),
         "mean_fitness": float(jax.device_get(stats)[0]),
         "use_pallas": bool(es.use_pallas),
         "rollout_unroll": int(os.environ.get("FIBER_ROLLOUT_UNROLL",
@@ -606,6 +607,7 @@ def _attention_bench(args, devices) -> int:
         "platform": devices[0].platform,
         "attn_flops_per_sec": round(attn_fps, 1),
         "mfu": _round_mfu(flopsmod.mfu(attn_fps, devices)),
+        **flopsmod.peak_report(devices),
     }
     # Record the ring measurement durably BEFORE the A/B leg: a wedged
     # Mosaic warmup hard-exits via its watchdog, and the chip number
@@ -805,6 +807,7 @@ def _lm_bench(args, devices) -> int:
         "model_flops_per_step": round(step_flops, 1),
         "model_flops_per_sec": round(model_fps, 1),
         "mfu": _round_mfu(flopsmod.mfu(model_fps, devices)),
+        **flopsmod.peak_report(devices),
     }
     # Ring number recorded durably before the kernel A/B leg (a wedged
     # Mosaic compile must not erase it).
@@ -888,6 +891,7 @@ def _poet_bench(args, devices) -> int:
         "n_devices": len(devices),
         "model_flops_per_sec": round(model_fps, 1),
         "mfu": _round_mfu(flopsmod.mfu(model_fps, devices)),
+        **flopsmod.peak_report(devices),
         "final_pairs": history[-1]["pairs"],
         "total_transfers": sum(h["transfers"] for h in history),
         "fitness_first_iter": round(history[0]["mean_fitness"], 2),

@@ -29,6 +29,7 @@ unlisted hardware.
 from __future__ import annotations
 
 import os
+import sys
 from typing import Optional, Sequence
 
 #: bf16 peak matmul FLOP/s per jax device, by substring of device_kind
@@ -58,20 +59,54 @@ ENV_STEP_FLOPS = {
 }
 
 
+#: device_kinds already reported (warn once per kind per process)
+_reported_miss: set = set()
+
+
+def _resolve_peak(device):
+    """Single source of truth for peak resolution — both the MFU math
+    (device_peak_flops) and the audit fields (peak_report) derive from
+    this, so the reported row can never diverge from the peak used.
+
+    Returns ``(kind, peak, row)``: lowercased device_kind (platform as
+    fallback), peak FLOP/s or None, and the human-auditable row string
+    ("env:...", "<table-sub>:<peak>", or None)."""
+    kind = ((getattr(device, "device_kind", "") or "").lower()
+            or getattr(device, "platform", ""))
+    env = os.environ.get("FIBER_PEAK_FLOPS")
+    if env:
+        peak = float(env)
+        return kind, peak, f"env:{peak:.4g}"
+    if "tpu" not in kind and getattr(device, "platform", "") != "tpu":
+        return kind, None, None
+    for sub, peak in _PEAK_BY_KIND:
+        if sub in kind:
+            return kind, peak, f"{sub}:{peak:.4g}"
+    return kind, None, None
+
+
 def device_peak_flops(device) -> Optional[float]:
     """bf16 peak matmul FLOP/s for one jax device, or None if unknown
     (e.g. the CPU fallback — an MFU against a CPU 'peak' would be
-    noise, not signal)."""
-    env = os.environ.get("FIBER_PEAK_FLOPS")
-    if env:
-        return float(env)
-    kind = (getattr(device, "device_kind", "") or "").lower()
-    if "tpu" not in kind and getattr(device, "platform", "") != "tpu":
-        return None
-    for sub, peak in _PEAK_BY_KIND:
-        if sub in kind:
-            return peak
-    return None
+    noise, not signal). A TPU device_kind that matches NO peak-table
+    row is a loud failure (stderr, once per kind): a silent None here
+    would make the first real-hardware MFU quietly null."""
+    kind, peak, row = _resolve_peak(device)
+    is_tpu = "tpu" in kind or getattr(device, "platform", "") == "tpu"
+    if peak is None and is_tpu and kind not in _reported_miss:
+        _reported_miss.add(kind)
+        print(f"FLOPS PEAK TABLE MISS: device_kind={kind!r} matched no "
+              f"_PEAK_BY_KIND row; mfu will be null — set "
+              f"FIBER_PEAK_FLOPS to override", file=sys.stderr, flush=True)
+    return peak
+
+
+def peak_report(devices: Sequence) -> dict:
+    """Self-validation fields for bench records: the device_kind the
+    measurement ran on and which peak-table row (or env override) it
+    resolved to, so an MFU figure is auditable without rerunning."""
+    kind, _, row = _resolve_peak(devices[0])
+    return {"device_kind": kind, "peak_row": row}
 
 
 def mfu(flops_per_sec: float, devices: Sequence) -> Optional[float]:
@@ -135,7 +170,8 @@ def tinylm_flops_per_step(model, seq: int, train: bool = True) -> float:
         + matmul_flops(seq, d, d)       # wo
         + matmul_flops(seq, d, h)       # w1
         + matmul_flops(seq, h, d)       # w2
-        + attention_flops(seq, model.heads, model.head_dim, causal=True)
+        + attention_flops(seq, model.heads, model.head_dim, causal=True,
+                          window=getattr(model, "window", None))
     )
     fwd = model.layers * per_block + matmul_flops(seq, d, model.vocab)
     return fwd * (3.0 if train else 1.0)

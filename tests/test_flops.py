@@ -96,6 +96,64 @@ def test_peak_table_lookup(monkeypatch):
     assert flops.device_peak_flops(FakeDev("TPU v99")) is None
 
 
+def test_peak_table_miss_is_loud(monkeypatch, capsys):
+    """An unmatched TPU device_kind must shout to stderr (once), not
+    silently null the first real-hardware MFU (VERDICT r4 #4)."""
+    monkeypatch.delenv("FIBER_PEAK_FLOPS", raising=False)
+
+    class FakeDev:
+        platform = "tpu"
+        device_kind = "TPU v77 mystery"
+
+    flops._reported_miss.clear()
+    assert flops.device_peak_flops(FakeDev()) is None
+    err = capsys.readouterr().err
+    assert "FLOPS PEAK TABLE MISS" in err
+    assert "v77 mystery" in err
+    # second call: warn-once, no repeat
+    flops.device_peak_flops(FakeDev())
+    assert "PEAK TABLE MISS" not in capsys.readouterr().err
+
+
+def test_peak_report_fields(monkeypatch):
+    """bench records carry device_kind + the peak row it resolved to."""
+    monkeypatch.delenv("FIBER_PEAK_FLOPS", raising=False)
+
+    class FakeDev:
+        platform = "tpu"
+
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    rep = flops.peak_report([FakeDev("TPU v5 lite")])
+    assert rep["device_kind"] == "tpu v5 lite"
+    assert rep["peak_row"] == "v5 lite:1.97e+14"
+
+    rep = flops.peak_report([FakeDev("TPU v99")])
+    assert rep["peak_row"] is None
+
+    monkeypatch.setenv("FIBER_PEAK_FLOPS", "2e12")
+    rep = flops.peak_report([FakeDev("TPU v99")])
+    assert rep["peak_row"] == "env:2e+12"
+
+
+def test_tinylm_windowed_flops_honest():
+    """A windowed TinyLM must be credited windowed attention FLOPs, not
+    full-causal (advisor r4 #1): same model, window set, counts less."""
+    from fiber_tpu.models import TinyLM
+
+    full = TinyLM(vocab=256, dim=64, heads=8, layers=2, max_seq=4096)
+    windowed = TinyLM(vocab=256, dim=64, heads=8, layers=2,
+                      max_seq=4096, window=256, attention="flash")
+    f_full = flops.tinylm_flops_per_step(full, 4096, train=False)
+    f_win = flops.tinylm_flops_per_step(windowed, 4096, train=False)
+    assert f_win < f_full
+    # the delta is exactly the attention delta
+    att_full = flops.attention_flops(4096, 8, 8, causal=True)
+    att_win = flops.attention_flops(4096, 8, 8, causal=True, window=256)
+    assert f_full - f_win == pytest.approx(2 * (att_full - att_win))
+
+
 def test_windowed_attention_flops():
     """Windowed FLOPs: ramp-up prefix + steady state, never more than
     full causal, linear in window for seq >> window."""

@@ -32,8 +32,8 @@ bench:
 	FIBER_BENCH_ENFORCE=1 python bench.py
 
 # Weak-scaling record over 1/2/4/8-device sim meshes (fused ES,
-# population scaled with devices) -> RUNS/weak_scaling.json. On chip
-# the same entry records real scaling.
+# population scaled with devices) + strong curve (constant total pop)
+# -> RUNS/weak_scaling_r05.json. On chip the same entry records real scaling.
 weakscale:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	JAX_PLATFORMS=cpu python __graft_entry__.py --weak-scaling

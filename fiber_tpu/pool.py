@@ -1026,9 +1026,11 @@ class Pool:
                 self._taskq.put((payload, (seq, base)))
         if self._resilient and getattr(self, "_parked_count", 0):
             # New chunks can clear parked requests' reservation gates.
+            # Narrow except: only shutdown races are benign — wake()'s
+            # wrong-mode RuntimeError must stay loud.
             try:
                 self._task_ep.wake()
-            except Exception:
+            except (TransportClosed, OSError):
                 pass
         return result
 
@@ -1404,6 +1406,13 @@ class ResilientPool(Pool):
         parked: Dict[bytes, Tuple[Any, int]] = {}  # ident -> (chan, pid)
 
         def sync_parked() -> None:
+            # SINGLE-WRITER INVARIANT: _parked_count is written only
+            # here, on the task loop's thread. submit/_on_result threads
+            # read it unlocked (_gate_allows) — that is safe only
+            # because a stale read degrades to the 0.5 s recv-timeout
+            # retry, never to a lost task. If the loop is ever
+            # restructured to mutate parked from another thread, this
+            # must become a locked counter.
             self._parked_count = len(parked)
 
         def drain_done() -> bool:
@@ -1531,9 +1540,10 @@ class ResilientPool(Pool):
         # while nothing is parked (the hot path of a plentiful-chunk
         # map must not pay an inbox put per result).
         if self._parked_count:
+            # Narrow except: shutdown races only (see submit-side twin).
             try:
                 self._task_ep.wake()
-            except Exception:
+            except (TransportClosed, OSError):
                 pass
 
     def _reclaim_ident(self, ident: bytes) -> int:

@@ -468,7 +468,14 @@ class Endpoint:
         nudge the handout would notice only at its next timeout).
         Coalesced: at most one nudge sits in the inbox at a time (the
         clear-after-pop race can drop a nudge, which costs one recv
-        timeout turn at worst — the fallback that existed anyway)."""
+        timeout turn at worst — the fallback that existed anyway).
+
+        rep-mode only: plain :meth:`recv` unpacks inbox items as
+        ``(chan, frame)`` and would crash on the bare ``_WAKE``
+        sentinel — only ``recv_req``/``poll`` know to skip it."""
+        if self.mode != "rep":
+            raise RuntimeError(
+                f"wake() needs a rep-mode endpoint, not {self.mode!r}")
         if self._wake_queued:
             return
         self._wake_queued = True

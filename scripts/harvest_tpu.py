@@ -146,11 +146,14 @@ def doctor_transcript(tag: str = "r5") -> None:
     # once the file is large AND this transcript is healthy; failures
     # are always recorded.
     try:
-        big = os.path.getsize(path) > 100_000
+        size = os.path.getsize(path)
     except OSError:
-        big = False
-    if big and rc == 0:
-        log(f"doctor transcript: rc=0 (healthy, {path} already large "
+        size = 0
+    # Healthy transcripts stop at 100 KB; failures get 5x more room
+    # but are bounded too — a persistently failing doctor in the
+    # infinite loop must not grow the file forever either.
+    if (rc == 0 and size > 100_000) or size > 500_000:
+        log(f"doctor transcript: rc={rc} ({path} at size cap "
             f"— not appended)")
         return
     with open(path, "a") as fh:

@@ -164,24 +164,129 @@ def test_up_executes_ssh_per_host(tmp_path, monkeypatch):
         assert "--bind 0.0.0.0" in line
 
 
-def test_up_executes_gcloud_for_tpu_name(tmp_path, monkeypatch):
-    """`fiber-tpu up --tpu NAME`: drives gcloud compute tpus tpu-vm ssh
-    with --worker all (no --hosts, so no probe phase)."""
+def _fake_gcloud(tmp_path, record, describe_stdout):
+    """PATH-shadowing gcloud: records every call; `describe` prints the
+    canned payload (the seam for worker-address derivation)."""
+    script = tmp_path / "gcloud"
+    payload = tmp_path / "describe.json"
+    payload.write_text(describe_stdout)
+    script.write_text(
+        "#!/bin/sh\n"
+        f'echo "$@" >> {record}\n'
+        'case "$*" in *describe*) cat ' + str(payload) + ";; esac\n"
+    )
+    script.chmod(0o755)
+    return script
+
+
+def test_up_tpu_derives_probe_hosts_and_fails_when_agents_down(
+        tmp_path, monkeypatch, capsys):
+    """`fiber-tpu up --tpu NAME` without --hosts must DERIVE the worker
+    addresses from `gcloud describe` and still verify (VERDICT r4 #5:
+    an `up` that confirmed nothing may not return 0). The fake gcloud
+    starts no agents, so the derived-address probe must fail."""
+    import json as _json
     import os
 
     from fiber_tpu.cli import main
 
     record = tmp_path / "gcloud.log"
-    _fake_bin(tmp_path, "gcloud", record)
+    endpoints = {"networkEndpoints": [
+        {"ipAddress": "10.164.0.2",
+         "accessConfig": {"externalIp": "127.0.0.1"}},
+    ]}
+    _fake_gcloud(tmp_path, record, _json.dumps(endpoints))
     monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ['PATH']}")
+    monkeypatch.delenv("FIBER_CLUSTER_KEY", raising=False)
 
-    rc = main(["up", "--tpu", "my-pod", "--zone", "us-central2-b"])
-    assert rc == 0
-    line = record.read_text().strip()
-    assert "compute tpus tpu-vm ssh my-pod" in line
-    assert "--zone us-central2-b" in line
-    assert "--worker all" in line
-    assert "fiber_tpu.host_agent" in line
+    rc = main(["up", "--tpu", "my-pod", "--zone", "us-central2-b",
+               "--port", "7199", "--wait", "0.5"])
+    assert rc == 1  # derived 127.0.0.1:7199, probed it, nobody home
+    lines = record.read_text()
+    assert "compute tpus tpu-vm ssh my-pod" in lines
+    assert "--worker all" in lines
+    assert "compute tpus tpu-vm describe my-pod" in lines
+    assert "--zone us-central2-b" in lines
+    err = capsys.readouterr().err
+    # the failure is the PROBE timing out, not a skipped verification
+    assert "could NOT be verified" not in err
+
+
+def test_up_tpu_derivation_failure_is_loud(tmp_path, monkeypatch,
+                                           capsys):
+    """If `gcloud describe` yields nothing usable, `up --tpu` must say
+    the agents are unverified and exit nonzero — never silently 0."""
+    import os
+
+    from fiber_tpu.cli import main
+
+    record = tmp_path / "gcloud.log"
+    _fake_gcloud(tmp_path, record, "not json at all")
+    monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ['PATH']}")
+    monkeypatch.delenv("FIBER_CLUSTER_KEY", raising=False)
+
+    rc = main(["up", "--tpu", "my-pod", "--wait", "0.5"])
+    assert rc == 1
+    assert "could NOT be verified" in capsys.readouterr().err
+
+
+def test_up_tpu_derived_probe_succeeds_against_real_agent(
+        tmp_path, monkeypatch, capsys):
+    """The full no---hosts gcloud path: mocked shell seam starts a REAL
+    local agent for the ssh leg, the describe leg derives 127.0.0.1,
+    and `up` verifies it end to end (rc 0)."""
+    import json as _json
+    import os
+    import re
+    import socket
+
+    from fiber_tpu import cli
+
+    key = "derive-test-key-0123456789abcdef0123456789ab"
+    monkeypatch.setenv("FIBER_CLUSTER_KEY", key)
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    procs = []
+
+    def fake_shell(cmd):
+        m = re.search(r"--port (\d+)", cmd)
+        assert m, cmd
+        env = dict(os.environ, FIBER_CLUSTER_KEY=key)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "fiber_tpu.host_agent",
+             "--port", m.group(1), "--bind", "127.0.0.1"],
+            env=env,
+        ))
+        return 0
+
+    def fake_capture(cmd):
+        assert "describe my-pod" in cmd
+        return 0, _json.dumps({"networkEndpoints": [
+            {"accessConfig": {"externalIp": "127.0.0.1"}},
+        ]}), ""
+
+    monkeypatch.setattr(cli, "_run_shell", fake_shell)
+    monkeypatch.setattr(cli, "_run_shell_capture", fake_capture)
+    import shutil
+
+    monkeypatch.setattr(shutil, "which", lambda name: f"/usr/bin/{name}")
+    try:
+        rc = cli.main(["up", "--tpu", "my-pod", "--port", str(port),
+                       "--wait", "60"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert f"127.0.0.1:{port}" in out  # derived address in next-steps
+        assert len(procs) == 1
+        assert cli.main(["down", "--hosts",
+                         f"127.0.0.1:{port}"]) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+                p.wait(10)
 
 
 def test_up_run_cp_down_end_to_end(tmp_path, monkeypatch, capsys):

@@ -51,20 +51,32 @@ def test_dryrun_multichip_wide(n):
 
 
 def test_weak_scaling_record_structure():
-    """The weak-scaling entry (VERDICT r3 #8: the multichip story needs
-    a throughput signal, not just ok) produces a monotone-population
-    curve with per-device efficiency fields — tiny config so the suite
-    stays fast; the full record is `make weakscale`."""
+    """The scaling entry (VERDICT r3 #8 + r4 #6) records BOTH curves:
+    weak (pop grows with n) and strong (constant total pop — the
+    contention-free overhead signal on a shared-core mesh) — tiny
+    config so the suite stays fast; the full record is
+    `make weakscale`."""
     import __graft_entry__ as ge
 
     rec = ge.weak_scaling(mesh_sizes=(1, 2), gens=2, per_device_pop=8,
                           steps=10)
-    assert rec["curve"], rec
-    ns = [c["n_devices"] for c in rec["curve"]]
+    weak, strong = rec["weak"], rec["strong"]
+    assert weak["curve"] and strong["curve"], rec
+    ns = [c["n_devices"] for c in weak["curve"]]
     assert ns == [1, 2]
-    for c in rec["curve"]:
+    for c in weak["curve"]:
         assert c["pop_size"] == 8 * c["n_devices"]
         assert c["steps_per_sec"] > 0
         assert c["evals_per_sec_per_device"] > 0
-    assert len(rec["scaling_efficiency_vs_1dev"]) == 2
-    assert rec["scaling_efficiency_vs_1dev"][0] == 1.0
+    assert len(weak["efficiency_vs_1dev"]) == 2
+    assert weak["efficiency_vs_1dev"][0] == 1.0
+    # strong: SAME total population at every mesh size
+    assert {c["pop_size"] for c in strong["curve"]} == {16}
+    assert [c["n_devices"] for c in strong["curve"]] == [1, 2]
+    assert strong["overhead_vs_1dev"][0] == 1.0
+    for c in strong["curve"]:
+        assert c["wall_sec"] > 0
+    # each sub-record labels what it can and cannot detect
+    assert "oversubscription" in weak["note"] or "by construction" \
+        in weak["note"]
+    assert "overhead" in strong["note"]

@@ -10,15 +10,13 @@ test:
 	$(PYTEST)
 
 # Tier 2: simulated multi-host pod slice (host agents on localhost —
-# the reference's Docker-backend role). --capture=sys: with pytest's
-# default fd-level capture, the FULL suite on this tier hits a bare
-# SIGABRT (no libc/XLA message) deterministically around the heavy LM
-# jit tests — repro notes in RUNS/stest_abort_repro.md; every partial
-# run and the capture=sys / capture=no runs are green, so the suite
-# itself is sound and sys-level capture (capsys still works) is the
-# stable configuration.
+# the reference's Docker-backend role). Runs under pytest's DEFAULT
+# fd capture: the round-4 SIGABRT that forced a --capture=sys
+# mitigation stopped reproducing after the poison-chunk crash-loop
+# fix and the stray-agent cleanup (3 green full-suite runs recorded;
+# history + diagnosis kit in RUNS/stest_abort_repro.md).
 stest:
-	FIBER_BACKEND=tpu FIBER_TPU_HOSTS=sim:2 $(PYTEST) --capture=sys
+	FIBER_BACKEND=tpu FIBER_TPU_HOSTS=sim:2 $(PYTEST)
 
 # Tier 3 runs on a real pod slice: start agents with `fiber-tpu up`,
 # then FIBER_BACKEND=tpu FIBER_TPU_HOSTS=host1,host2 make test

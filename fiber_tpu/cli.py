@@ -357,9 +357,11 @@ def cmd_up(args) -> int:
     # not leave the probes on the default key while the agents run the
     # generated one. When the env was set non-empty, key equals it.
     os.environ["FIBER_CLUSTER_KEY"] = key
-    if args.wait <= 0:
-        # The explicit opt-out (vs. the pre-r5 silent skip): operators
-        # behind a firewall that drops the probe can still bring up.
+    if args.wait <= 0 and args.tpu and not probe_hosts:
+        # Explicit opt-out, scoped to the derived-address path only
+        # (an operator whose firewall drops the gcloud-derived probe
+        # can still bring up). With --hosts, --wait 0 keeps its old
+        # meaning: one immediate probe pass, nonzero if not live.
         print("up: agents started; verification SKIPPED by request "
               "(--wait 0) — agents are UNCONFIRMED", file=sys.stderr)
         return 0
@@ -672,8 +674,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dry-run", action="store_true",
                    help="print the bring-up commands without running")
     p.add_argument("--wait", type=float, default=60.0,
-                   help="seconds to wait for agents to answer "
-                        "(0 = skip verification explicitly)")
+                   help="seconds to wait for agents to answer (with "
+                        "--tpu and no --hosts, 0 skips verification "
+                        "explicitly; with --hosts, 0 = one immediate "
+                        "probe pass)")
     # pre-r4 compat: execution is the default now
     p.add_argument("--execute", action="store_true",
                    help=argparse.SUPPRESS)

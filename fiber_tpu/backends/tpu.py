@@ -82,7 +82,11 @@ class AgentClient:
                 self._conn = None
 
 
-def _parse_hosts(spec: str) -> List[Tuple[str, int]]:
+def _parse_hosts(spec: str,
+                 default_port: int = 0) -> List[Tuple[str, int]]:
+    """Parse ``ip[,ip:port,...]``; portless entries take
+    ``default_port`` (the CLI passes the operator's --port so started
+    and probed ports can never disagree) or DEFAULT_AGENT_PORT."""
     hosts = []
     for part in spec.split(","):
         part = part.strip()
@@ -96,7 +100,7 @@ def _parse_hosts(spec: str) -> List[Tuple[str, int]]:
                 )
             hosts.append((host, int(port_s)))
         else:
-            hosts.append((part, DEFAULT_AGENT_PORT))
+            hosts.append((part, default_port or DEFAULT_AGENT_PORT))
     return hosts
 
 

@@ -41,13 +41,39 @@ def _hosts_from_args(args) -> str:
     return hosts
 
 
-def _parse_hosts_cli(spec: str):
+def _parse_hosts_cli(spec: str, default_port: int = 0):
     from fiber_tpu.backends.tpu import _parse_hosts
 
     try:
-        return _parse_hosts(spec)
+        return _parse_hosts(spec, default_port)
     except ValueError as err:
         raise SystemExit(f"error: {err}") from None
+
+
+def _resolve_cli_hosts(args):
+    """The one host-resolution story for every agent-facing subcommand
+    (status/doctor/cp/down): explicit --hosts (or FIBER_TPU_HOSTS)
+    parsed with --port as the portless default, else --tpu derives the
+    worker addresses via gcloud describe — the same seam `up` uses.
+    Precedence matches `up`: explicit --tpu outranks a stale env
+    (stopping/probing cluster B must not touch cluster A)."""
+    from fiber_tpu.host_agent import DEFAULT_AGENT_PORT
+
+    port = getattr(args, "port", 0)
+    if getattr(args, "tpu", "") and not args.hosts:
+        try:
+            return _derive_tpu_probe_hosts(
+                args.tpu, getattr(args, "zone", ""),
+                port or DEFAULT_AGENT_PORT)
+        except RuntimeError as err:
+            raise SystemExit(
+                f"error: could not derive worker addresses from "
+                f"gcloud describe ({err}); pass --hosts ip[:port],...")
+    spec = args.hosts or os.environ.get("FIBER_TPU_HOSTS", "")
+    if not spec:
+        raise SystemExit(
+            "error: --hosts (or FIBER_TPU_HOSTS) or --tpu is required")
+    return _parse_hosts_cli(spec, port)
 
 
 def _run_script(script: str, script_args: List[str]) -> None:
@@ -296,24 +322,9 @@ def cmd_up(args) -> int:
         )
 
     def parse_up_hosts(spec: str):
-        # Unlike _parse_hosts, portless entries take --port (or the
-        # default) so the STARTED port and the PROBED port can never
-        # disagree.
-        out = []
-        for part in spec.split(","):
-            part = part.strip()
-            if not part:
-                continue
-            if ":" in part:
-                h, p = part.rsplit(":", 1)
-                if not h or not p.isdigit():
-                    raise SystemExit(
-                        f"error: malformed host entry {part!r} "
-                        "(want ip or ip:port)")
-                out.append((h, int(p)))
-            else:
-                out.append((part, port))
-        return out
+        # Portless entries take --port so the STARTED port and the
+        # PROBED port can never disagree.
+        return _parse_hosts_cli(spec, port)
 
     if args.tpu:
         driver = "gcloud"
@@ -405,7 +416,7 @@ def cmd_down(args) -> int:
     from fiber_tpu.backends.tpu import AgentClient
 
     rc = 0
-    for host, port in _parse_hosts_cli(_hosts_from_args(args)):
+    for host, port in _resolve_cli_hosts(args):
         client = AgentClient(host, port)
         try:
             # Ping FIRST: connection-refused on a dead host must surface
@@ -443,7 +454,7 @@ def _probe_agent(host: str, port: int):
 
 def cmd_status(args) -> int:
     rc = 0
-    for host, port in _parse_hosts_cli(_hosts_from_args(args)):
+    for host, port in _resolve_cli_hosts(args):
         try:
             info, jobs = _probe_agent(host, port)
             print(f"{host}:{port}  up  cpus={info['cpu_count']} "
@@ -552,13 +563,20 @@ def cmd_doctor(args) -> int:
          "DEFAULT (development only — set FIBER_CLUSTER_KEY on real "
          "clusters)" if default_key else "custom (FIBER_CLUSTER_KEY)")
 
-    # 6. agents (optional: no host list just skips the section)
+    # 6. agents (optional: no host list and no --tpu skips the section)
     hosts_spec = args.hosts or os.environ.get("FIBER_TPU_HOSTS", "")
     if hosts_spec.startswith("sim:"):
         print(f"[  --] agents: {hosts_spec} spawns local agents on "
               "demand — nothing standing to probe")
-    elif hosts_spec:
-        for host, port in _parse_hosts_cli(hosts_spec):
+    elif hosts_spec or getattr(args, "tpu", ""):
+        try:
+            agent_hosts = _resolve_cli_hosts(args)
+        except SystemExit as err:
+            # doctor reports, it doesn't die: a failed gcloud
+            # derivation is itself a diagnostic finding
+            line(False, "agents", str(err))
+            agent_hosts = []
+        for host, port in agent_hosts:
             try:
                 info, _ = _probe_agent(host, port)
                 line(True, f"agent {host}:{port}",
@@ -604,7 +622,7 @@ def cmd_cp(args) -> int:
     """
     from fiber_tpu.backends.tpu import AgentClient
 
-    hosts = _parse_hosts_cli(_hosts_from_args(args))
+    hosts = _resolve_cli_hosts(args)
     if ":" in args.src and not os.path.exists(args.src):
         host_part, path = args.src.split(":", 1)
         matches = [h for h in hosts if h[0] == host_part]
@@ -685,15 +703,34 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("down", help="stop agents via their shutdown RPC")
     p.add_argument("--hosts", default="")
+    p.add_argument("--tpu", default="",
+                   help="TPU name: derive worker addresses via gcloud "
+                        "describe (same derivation as `up --tpu`)")
+    p.add_argument("--zone", default="")
+    p.add_argument("--port", type=int, default=0)
     p.set_defaults(fn=cmd_down)
 
     p = sub.add_parser("status", help="ping every host agent")
     p.add_argument("--hosts", default="")
+    p.add_argument("--tpu", default="",
+                   help="TPU name: derive worker addresses via gcloud "
+                        "describe when --hosts is absent")
+    p.add_argument("--zone", default="")
+    p.add_argument("--port", type=int, default=0,
+                   help="port for portless --hosts entries / derived "
+                        "addresses")
     p.set_defaults(fn=cmd_status)
 
     p = sub.add_parser("doctor",
                        help="diagnose the environment and cluster")
     p.add_argument("--hosts", default="")
+    p.add_argument("--tpu", default="",
+                   help="TPU name: derive worker addresses via gcloud "
+                        "describe when --hosts is absent")
+    p.add_argument("--zone", default="")
+    p.add_argument("--port", type=int, default=0,
+                   help="port for portless --hosts entries / derived "
+                        "addresses")
     p.add_argument("--timeout", type=float, default=20.0,
                    help="seconds to wait for the jax device probe")
     p.set_defaults(fn=cmd_doctor)
@@ -707,6 +744,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("src")
     p.add_argument("dst")
     p.add_argument("--hosts", default="")
+    p.add_argument("--tpu", default="",
+                   help="TPU name: derive worker addresses via gcloud "
+                        "describe when --hosts is absent")
+    p.add_argument("--zone", default="")
+    p.add_argument("--port", type=int, default=0,
+                   help="port for portless --hosts entries / derived "
+                        "addresses")
     p.set_defaults(fn=cmd_cp)
 
     return parser

@@ -27,6 +27,8 @@ import time
 from multiprocessing.connection import Listener
 from typing import Any, Dict, Optional, Tuple
 
+from fiber_tpu.utils.serve import serve_authenticated
+
 DEFAULT_AGENT_PORT = 7060
 
 
@@ -102,9 +104,15 @@ class HostAgent:
         # (tests, tooling) must only stop serving — os._exit(0) from a
         # library call would kill the host interpreter silently.
         self._exit_on_shutdown = exit_on_shutdown
-        self._listener = Listener(
-            (bind, port), authkey=authkey or cluster_authkey()
-        )
+        # No authkey on the Listener: accept() must return after the
+        # bare TCP accept so one hostile/stalled client can't block the
+        # accept loop inside the HMAC challenge. The SAME mutual
+        # challenge (deliver_challenge + answer_challenge, exactly what
+        # Listener.accept(authkey=...) would run) happens per
+        # connection in its own thread, under a kernel-level recv
+        # timeout — see _serve.
+        self._authkey = authkey or cluster_authkey()
+        self._listener = Listener((bind, port))
         self.port = self._listener.address[1]
         # Jobs are keyed by a monotonically increasing id, never the OS
         # pid — pid reuse must not alias a finished job's record.
@@ -114,15 +122,25 @@ class HostAgent:
         self._stop = threading.Event()
 
     def serve_forever(self) -> None:
-        while not self._stop.is_set():
-            try:
-                conn = self._listener.accept()
-            except (OSError, EOFError):
-                break
-            threading.Thread(
-                target=self._serve, args=(conn,),
-                name="fiber-agent-conn", daemon=True,
-            ).start()
+        # Hostile or broken clients must never take the agent down or
+        # starve it (pre-fix, one bare TCP connect-close exited the
+        # daemon rc 0, and one connect-and-hold client stalled every
+        # other RPC inside the accept-time challenge). The shared
+        # hardened loop TCP-accepts only and authenticates each
+        # connection on its own thread under hard deadlines and a
+        # pre-auth connection cap (fiber_tpu/utils/serve.py).
+        serve_authenticated(self._listener, self._authkey, self._stop,
+                            self._serve, "fiber-agent-conn")
+
+    def stop(self) -> None:
+        """Stop serving (embedded agents / teardown): sets the flag
+        BEFORE closing the listener so serve_forever's OSError path
+        exits instead of retrying."""
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
 
     def _serve(self, conn) -> None:
         try:

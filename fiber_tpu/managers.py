@@ -23,6 +23,7 @@ from multiprocessing.connection import Client, Listener
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from fiber_tpu.utils.logging import get_logger
+from fiber_tpu.utils.serve import serve_authenticated
 
 logger = get_logger()
 
@@ -73,7 +74,14 @@ class Server:
         # listen ip) — 0.0.0.0 exposed the HMAC-pickle RPC to every
         # interface even for purely local backends (advisor, round 1).
         ip, _, _ = get_backend().get_listen_addr()
-        self._listener = Listener((ip, 0), authkey=bytes(authkey))
+        # No authkey on the Listener: the shared hardened loop runs the
+        # same mutual challenge per connection instead, so a hostile
+        # client (connect-close, connect-and-hold, wrong key) can
+        # neither kill this plane's accept loop nor stall other
+        # proxies (fiber_tpu/utils/serve.py; the host agent had the
+        # identical exposure).
+        self._authkey = bytes(authkey)
+        self._listener = Listener((ip, 0))
         self.address: Tuple[str, int] = (ip, self._listener.address[1])
         self._objects: Dict[int, Any] = {}
         self._next_ident = 0
@@ -81,15 +89,8 @@ class Server:
         self._stop = threading.Event()
 
     def serve_forever(self) -> None:
-        while not self._stop.is_set():
-            try:
-                conn = self._listener.accept()
-            except (OSError, EOFError):
-                break
-            threading.Thread(
-                target=self._serve_connection, args=(conn,),
-                name="fiber-manager-conn", daemon=True,
-            ).start()
+        serve_authenticated(self._listener, self._authkey, self._stop,
+                            self._serve_connection, "fiber-manager-conn")
         try:
             self._listener.close()
         except OSError:

@@ -1,0 +1,170 @@
+"""Hardened accept/serve loop shared by the authenticated RPC planes
+(host agent, managers server).
+
+Both planes speak multiprocessing.connection's mutual HMAC challenge.
+Stock ``Listener(authkey=...).accept()`` runs that challenge inline,
+which couples the accept loop to the worst client on the network: a
+bare TCP connect-close (port scanner, load-balancer health check)
+raises out of accept and kills the loop; a connect-and-hold client
+parks the loop inside the challenge and stalls every other RPC; a
+wrong-key client raises AuthenticationError out of it. The reference
+framework delegated this exposure to nanomsg/Kubernetes networking;
+here the daemons ARE the cluster substrate, so they take the hostile
+LAN seriously themselves.
+
+Shape: the listener authenticates nothing (TCP accept returns
+immediately); each connection gets a thread that runs the SAME mutual
+challenge (deliver_challenge + answer_challenge, exactly what
+``Listener.accept(authkey=...)`` would run) under two bounds —
+
+- a kernel-level ``SO_RCVTIMEO`` (set on the file description via a
+  dup'd fd, because Connection does raw ``os.read`` and Python-level
+  socket timeouts would not apply), cleared after auth so idle
+  authenticated clients are unaffected; and
+- an ABSOLUTE deadline enforced by a timer that ``shutdown(2)``-s the
+  socket (again via a dup'd fd — never a cross-thread ``close``,
+  which races fd reuse): a slow-drip client that feeds one byte per
+  read cannot stretch the per-recv timeout into minutes.
+
+Unauthenticated connections are additionally capped in number, so a
+flood of half-open connects exhausts neither threads nor fds.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from multiprocessing.connection import answer_challenge, deliver_challenge
+from multiprocessing.context import AuthenticationError
+from typing import Callable, Optional
+
+#: Max connections allowed to sit in the unauthenticated handshake at
+#: once; further connects are dropped immediately (they can retry).
+DEFAULT_PREAUTH_CAP = 64
+
+#: Absolute bound on one handshake, seconds.
+HANDSHAKE_DEADLINE = 15.0
+
+
+def _on_description(conn, fn) -> None:
+    """Run ``fn(sock)`` against ``conn``'s underlying file description
+    through a dup'd fd (safe from any thread: the dup is private, and
+    description-level state — socket options, shutdown — reaches the
+    original without ever closing its fd)."""
+    try:
+        s = socket.socket(fileno=os.dup(conn.fileno()))
+    except OSError:
+        return
+    try:
+        fn(s)
+    except OSError:
+        pass
+    finally:
+        s.close()
+
+
+def _set_rcvtimeo(conn, seconds: int) -> None:
+    _on_description(conn, lambda s: s.setsockopt(
+        socket.SOL_SOCKET, socket.SO_RCVTIMEO,
+        struct.pack("ll", seconds, 0)))
+
+
+def _force_eof(conn) -> None:
+    """Wake any blocked read on ``conn`` with EOF (deadline timer)."""
+    _on_description(conn, lambda s: s.shutdown(socket.SHUT_RDWR))
+
+
+def authenticate(conn, authkey: bytes,
+                 deadline: float = HANDSHAKE_DEADLINE) -> bool:
+    """Run the mutual HMAC challenge with hard time bounds; True on
+    success. On any failure (wrong key, garbage, EOF, timeout) the
+    connection is simply not authenticated — the caller closes it.
+
+    A handshake that finishes in a photo-finish with the deadline
+    counts as FAILED: the timer may already have shut the socket down
+    concurrently with the success path, and returning True for a
+    half-dead connection would hand the serve loop a conn that EOFs
+    on its first recv."""
+    fired = threading.Event()
+
+    def expire() -> None:
+        fired.set()
+        _force_eof(conn)
+
+    timer = threading.Timer(deadline, expire)
+    timer.daemon = True
+    timer.start()
+    try:
+        _set_rcvtimeo(conn, 10)
+        deliver_challenge(conn, authkey)
+        answer_challenge(conn, authkey)
+        _set_rcvtimeo(conn, 0)  # authenticated: block indefinitely again
+        return not fired.is_set()
+    except (AuthenticationError, EOFError, OSError, ValueError):
+        return False
+    finally:
+        timer.cancel()
+
+
+def serve_authenticated(listener, authkey: bytes,
+                        stop_event: threading.Event,
+                        handler: Callable,
+                        thread_name: str,
+                        preauth_cap: int = DEFAULT_PREAUTH_CAP,
+                        deadline: Optional[float] = None) -> None:
+    """Accept loop that survives hostile clients. Blocks until
+    ``stop_event`` is set AND the (closed) listener wakes the pending
+    accept. ``handler(conn)`` runs on a per-connection daemon thread
+    after successful authentication; it owns the conn's lifetime.
+
+    Contract with the stopper: set ``stop_event`` BEFORE closing the
+    listener (``OSError`` from a closed listener then exits the loop;
+    any other OSError is treated as per-connection/transient and
+    retried after a short sleep so one bad accept can't kill the
+    plane).
+
+    Flood posture is EVICT-OLDEST, not drop-newest: when the cap is
+    reached, the oldest still-unauthenticated connection is forcibly
+    EOF'd to free its slot and the new arrival is served. Dropping
+    the newcomer instead would let ``cap`` idle holders lock every
+    legitimate client out for a whole handshake-deadline window."""
+    pending: list = []  # unauthenticated conns, oldest first
+    gate = threading.Lock()
+
+    def guarded(conn) -> None:
+        try:
+            ok = authenticate(
+                conn, authkey,
+                deadline if deadline is not None else HANDSHAKE_DEADLINE)
+        finally:
+            with gate:
+                try:
+                    pending.remove(conn)
+                except ValueError:
+                    pass
+        if not ok:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        handler(conn)
+
+    while not stop_event.is_set():
+        try:
+            conn = listener.accept()
+        except OSError:
+            if stop_event.is_set():
+                break
+            time.sleep(0.05)
+            continue
+        with gate:
+            evict = pending[0] if len(pending) >= preauth_cap else None
+            pending.append(conn)
+        if evict is not None:
+            _force_eof(evict)  # its guarded() thread fails fast + cleans up
+        threading.Thread(target=guarded, args=(conn,),
+                         name=thread_name, daemon=True).start()

@@ -230,6 +230,108 @@ def test_up_tpu_derivation_failure_is_loud(tmp_path, monkeypatch,
     assert "could NOT be verified" in capsys.readouterr().err
 
 
+def test_down_tpu_derives_hosts_and_stops_agent(tmp_path, monkeypatch):
+    """`down --tpu NAME` (no --hosts): derives worker addresses via the
+    same gcloud-describe seam as `up` and stops the real agent through
+    its shutdown RPC."""
+    import json as _json
+    import os
+    import socket
+    import time as _time
+
+    from fiber_tpu import cli
+
+    key = "down-derive-key-0123456789abcdef0123456789ab"
+    monkeypatch.setenv("FIBER_CLUSTER_KEY", key)
+    monkeypatch.delenv("FIBER_TPU_HOSTS", raising=False)
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fiber_tpu.host_agent",
+         "--port", str(port), "--bind", "127.0.0.1"],
+        env=dict(os.environ, FIBER_CLUSTER_KEY=key),
+    )
+
+    def fake_capture(cmd):
+        assert "describe my-pod" in cmd
+        return 0, _json.dumps({"networkEndpoints": [
+            {"accessConfig": {"externalIp": "127.0.0.1"}},
+        ]}), ""
+
+    monkeypatch.setattr(cli, "_run_shell_capture", fake_capture)
+    try:
+        deadline = _time.time() + 30
+        while _time.time() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", port), 0.5).close()
+                break
+            except OSError:
+                _time.sleep(0.1)
+        rc = cli.main(["down", "--tpu", "my-pod", "--port", str(port)])
+        assert rc == 0
+        deadline = _time.time() + 30
+        while proc.poll() is None and _time.time() < deadline:
+            _time.sleep(0.2)
+        assert proc.poll() is not None
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            proc.wait(10)
+
+
+def test_down_port_applies_to_portless_hosts(monkeypatch):
+    """`down --hosts IP --port P` must dial P (same meaning --port has
+    for `up`), not silently fall back to the default agent port and
+    report a healthy agent unreachable."""
+    import threading
+
+    from fiber_tpu import cli
+    from fiber_tpu.host_agent import HostAgent
+
+    agent = HostAgent(0, bind="127.0.0.1")
+    t = threading.Thread(target=agent.serve_forever, daemon=True)
+    t.start()
+    try:
+        rc = cli.main(["down", "--hosts", "127.0.0.1",
+                       "--port", str(agent.port)])
+        assert rc == 0
+    finally:
+        agent.stop()
+
+
+def test_status_tpu_derives_hosts(monkeypatch, capsys):
+    """`status --tpu NAME` resolves worker addresses through the shared
+    resolver (every agent-facing subcommand speaks --tpu now)."""
+    import json as _json
+    import threading
+
+    from fiber_tpu import cli
+    from fiber_tpu.host_agent import HostAgent
+
+    agent = HostAgent(0, bind="127.0.0.1")
+    t = threading.Thread(target=agent.serve_forever, daemon=True)
+    t.start()
+
+    def fake_capture(cmd):
+        assert "describe my-pod" in cmd
+        return 0, _json.dumps({"networkEndpoints": [
+            {"accessConfig": {"externalIp": "127.0.0.1"}},
+        ]}), ""
+
+    monkeypatch.setattr(cli, "_run_shell_capture", fake_capture)
+    monkeypatch.delenv("FIBER_TPU_HOSTS", raising=False)
+    try:
+        rc = cli.main(["status", "--tpu", "my-pod",
+                       "--port", str(agent.port)])
+        assert rc == 0
+        assert f"127.0.0.1:{agent.port}  up" in capsys.readouterr().out
+    finally:
+        agent.stop()
+
+
 def test_up_tpu_derived_probe_succeeds_against_real_agent(
         tmp_path, monkeypatch, capsys):
     """The full no---hosts gcloud path: mocked shell seam starts a REAL
@@ -419,10 +521,7 @@ def test_backend_discovers_agents_from_tpu_worker_hostnames(monkeypatch):
     finally:
         config.get().update(tpu_hosts=old)
         for a in agents:
-            try:
-                a._listener.close()
-            except OSError:
-                pass
+            a.stop()
 
 
 def test_run_submit_launches_master_in_cluster(tmp_path, monkeypatch):
@@ -514,7 +613,4 @@ def test_logs_fetches_job_tail():
             main(["logs", "nonsense"])
     finally:
         client.close()
-        try:
-            agent._listener.close()
-        except OSError:
-            pass
+        agent.stop()

@@ -277,3 +277,39 @@ def test_condition_wait_for_runs_predicate_client_side():
             assert cond.wait_for(lambda: False, timeout=0.3) is False
     finally:
         manager.shutdown()
+
+
+def test_manager_server_survives_hostile_clients():
+    """The managers plane shares the hardened accept loop with the host
+    agent (fiber_tpu/utils/serve.py): a port scan's connect-close, a
+    garbage-sender, a wrong-key client, and a connect-and-hold socket
+    must neither kill the server nor stall authenticated proxies
+    (pre-fix, one connect-close broke the accept loop and a held
+    socket parked it inside the inline HMAC challenge)."""
+    import socket
+
+    manager = SyncManager()
+    manager.start()
+    try:
+        d = manager.dict()
+        d["k"] = 1
+        host, port = manager.address
+        for _ in range(3):
+            socket.create_connection((host, port), 2).close()
+        s = socket.create_connection((host, port), 2)
+        s.sendall(b"\x00\x01garbage")
+        s.close()
+        from multiprocessing.connection import Client
+
+        with pytest.raises(Exception):
+            Client((host, port), authkey=b"wrong-key")
+        holder = socket.create_connection((host, port), 2)
+        # live proxy keeps working while the holder sits unauthenticated
+        d["k2"] = 2
+        assert dict(d.items()) == {"k": 1, "k2": 2}
+        # and a FRESH authenticated connection can still be made
+        lst = manager.list([1, 2])
+        assert lst[1] == 2
+        holder.close()
+    finally:
+        manager.shutdown()

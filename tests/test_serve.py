@@ -1,0 +1,132 @@
+"""Hardened accept/handshake helper (fiber_tpu/utils/serve.py) — the
+shared defense of the agent and managers RPC planes against hostile
+clients."""
+
+import socket
+import threading
+import time
+
+from multiprocessing.connection import Client, Listener
+
+from fiber_tpu.utils import serve
+
+
+KEY = b"serve-test-key"
+
+
+def test_authenticate_slow_drip_hits_absolute_deadline():
+    """SO_RCVTIMEO alone is a PER-RECV timeout — a client feeding one
+    byte per interval could stretch the handshake for minutes. The
+    absolute deadline (timer + shutdown(2) via dup'd fd) must cut a
+    dripper off within ~deadline, not per-byte-forever."""
+    listener = Listener(("127.0.0.1", 0))
+    port = listener.address[1]
+    result = {}
+
+    def server():
+        conn = listener.accept()
+        t0 = time.time()
+        result["ok"] = serve.authenticate(conn, KEY, deadline=1.0)
+        result["took"] = time.time() - t0
+        conn.close()
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    s = socket.create_connection(("127.0.0.1", port), 5)
+    try:
+        # drip bytes slowly; each write resets a per-recv timeout but
+        # must NOT reset the absolute deadline
+        for _ in range(12):
+            try:
+                s.sendall(b"\x01")
+            except OSError:
+                break  # server shut the socket down at the deadline
+            time.sleep(0.25)
+    finally:
+        s.close()
+    t.join(15)
+    assert not t.is_alive()
+    assert result["ok"] is False
+    assert result["took"] < 5.0, result  # 1 s deadline + bounded slack
+    listener.close()
+
+
+def test_authenticate_accepts_real_client_and_clears_timeout():
+    """A legitimate mp Client authenticates, and the cleared rcvtimeo
+    lets it idle past the handshake deadline without being dropped."""
+    listener = Listener(("127.0.0.1", 0))
+    port = listener.address[1]
+    result = {}
+
+    def server():
+        conn = listener.accept()
+        result["ok"] = serve.authenticate(conn, KEY, deadline=2.0)
+        if result["ok"]:
+            # echo one message AFTER an idle period longer than the
+            # handshake deadline — the connection must still be alive
+            result["msg"] = conn.recv()
+            conn.send("ack")
+        conn.close()
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    c = Client(("127.0.0.1", port), authkey=KEY)
+    time.sleep(2.5)  # idle past the handshake deadline
+    c.send("hello")
+    assert c.recv() == "ack"
+    c.close()
+    t.join(10)
+    assert result["ok"] is True and result["msg"] == "hello"
+    listener.close()
+
+
+def test_preauth_cap_sheds_flood_but_serves_real_client():
+    """More unauthenticated connections than the cap: the OLDEST
+    holder is evicted per new arrival (drop-newest would let cap idle
+    holders lock every legitimate client out for a deadline window),
+    so a real client arriving over a standing flood still gets
+    served."""
+    listener = Listener(("127.0.0.1", 0))
+    port = listener.address[1]
+    stop = threading.Event()
+    served = []
+
+    def handler(conn):
+        served.append(conn.recv())
+        conn.send("ok")
+        conn.close()
+
+    t = threading.Thread(
+        target=serve.serve_authenticated,
+        args=(listener, KEY, stop, handler, "test-conn"),
+        kwargs={"preauth_cap": 4, "deadline": 2.0},
+        daemon=True,
+    )
+    t.start()
+    holders = []
+    try:
+        for _ in range(12):  # every arrival past 4 evicts the oldest
+            holders.append(
+                socket.create_connection(("127.0.0.1", port), 2))
+        time.sleep(0.3)
+        # the flood is standing (last 4 holders still own the slots);
+        # the real client's arrival evicts the oldest of them
+        c = Client(("127.0.0.1", port), authkey=KEY)
+        c.send("payload")
+        assert c.recv() == "ok"
+        c.close()
+        assert served == ["payload"]
+    finally:
+        for h in holders:
+            try:
+                h.close()
+            except OSError:
+                pass
+        stop.set()
+        listener.close()
+        # drain the parked accept so the loop thread exits
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.5).close()
+        except OSError:
+            pass
+        t.join(10)

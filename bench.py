@@ -163,9 +163,6 @@ def main() -> int:
                         help="bench long-context TRAINING instead: TinyLM "
                              "optimizer steps (fwd+bwd+adamw) with the "
                              "sequence ring-sharded at --seq tokens")
-    parser.add_argument("--ab-pallas", action="store_true",
-                        help="also time the ES with use_pallas forced off "
-                             "and report both (TPU A/B)")
     parser.add_argument("--profile", default="",
                         help="write a jax.profiler trace of the timed ES "
                              "section to this directory (inspect with "
@@ -361,7 +358,6 @@ def main() -> int:
         "mfu": _round_mfu(flopsmod.mfu(model_fps, devices)),
         **flopsmod.peak_report(devices),
         "mean_fitness": float(jax.device_get(stats)[0]),
-        "use_pallas": bool(es.use_pallas),
         "rollout_unroll": int(os.environ.get("FIBER_ROLLOUT_UNROLL",
                                              "1")),
         "policy_dtype": (os.environ.get("FIBER_POLICY_DTYPE")
@@ -376,46 +372,6 @@ def main() -> int:
     # file already carries the measurement (the final record call below
     # just enriches it).
     _record_or_attach_tpu_run(result, wedged=args.wedged_fallback)
-    if args.ab_pallas:
-        # Same workload on the OTHER noise path (auto resolves to the
-        # measured winner for the primary run; the A/B forces the other
-        # path so both timings are recorded). pallas_speedup > 1 means
-        # the fused pallas kernels beat plain jnp here. The watchdog
-        # re-arms for this leg: a wedged Mosaic compile on the flaky
-        # accelerator must still emit the already-measured primary
-        # result (the one-JSON-line contract).
-        ab_watchdog = _watchdog(args.init_timeout, dict(result))
-        try:
-            from fiber_tpu.ops.pallas_es import pallas_available
-
-            other_pallas = not es.use_pallas
-            if other_pallas and not pallas_available():
-                raise RuntimeError("pallas kernels unavailable")
-            es_other = EvolutionStrategy(
-                eval_fn, dim=policy.dim, pop_size=args.pop, sigma=0.1,
-                lr=0.03, mesh=mesh, use_pallas=other_pallas,
-            )
-            key, k = jax.random.split(key)
-            p2, warm2 = es_other.run_fused(params, k, args.gens)
-            jax.block_until_ready(warm2)
-            t0 = time.perf_counter()
-            key, k = jax.random.split(key)
-            _, s2 = es_other.run_fused(p2, k, args.gens)
-            jax.block_until_ready(s2)
-            other_elapsed = time.perf_counter() - t0
-            other_rate = round(total_evals / other_elapsed, 2)
-            if other_pallas:
-                t_pallas, t_jnp = other_elapsed, elapsed
-                result["evals_per_sec_pallas"] = other_rate
-            else:
-                t_pallas, t_jnp = elapsed, other_elapsed
-                result["evals_per_sec_no_pallas"] = other_rate
-            result["pallas_speedup"] = round(t_jnp / t_pallas, 3)
-        except Exception as err:  # noqa: BLE001
-            result["ab_pallas_error"] = repr(err)
-        finally:
-            ab_watchdog.cancel()
-
     if not args.no_pool_bench:
         try:
             result.update(_pool_bench())
@@ -503,15 +459,6 @@ def _record_or_attach_tpu_run(result: dict, wedged: bool) -> None:
         # labeled, so a wedged-day rerun at a weaker config can't erase
         # the headline number (each entry carries its own config).
         metric = result["metric"]
-        if result.get("use_pallas"):
-            # A pallas-forced run is NOT the shipping configuration
-            # (use_pallas="auto" resolves to the jnp path): it records
-            # under its own key so the metric key — what readers and the
-            # wedged-fallback attach below treat as the headline —
-            # always reflects defaults (round-2 verdict, Weak #2).
-            records[metric + "__pallas"] = result
-            _write_tpu_records(records)
-            return
         best_key = metric + "__best"
         prior_best = records.get(best_key) or records.get(metric)
         records[metric] = result
@@ -536,9 +483,10 @@ def _record_or_attach_tpu_run(result: dict, wedged: bool) -> None:
     if not wedged:
         return
     records = _load_tpu_records()
-    # Lead with the shipping configuration: never attach a pallas-forced
-    # run as the headline (legacy record files may still carry one under
-    # the metric key).
+    # Lead with the shipping configuration: never attach a legacy
+    # pallas-forced run as the headline (old record files may carry one
+    # under the metric key; the pallas_es experiment itself was deleted
+    # in round 5 on its standing 30x-slower on-chip record).
     candidates = [records.get(result["metric"]),
                   records.get(result["metric"] + "__best")]
     for recorded in candidates:

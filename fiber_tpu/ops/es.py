@@ -156,7 +156,6 @@ class EvolutionStrategy(_FusedRunMixin):
         lr: float = 0.02,
         mesh=None,
         weight_decay: float = 0.0,
-        use_pallas: str | bool = "auto",
         optimizer: str = "sgd",
     ) -> None:
         import numpy as np
@@ -178,28 +177,14 @@ class EvolutionStrategy(_FusedRunMixin):
         quantum = 2 * self.n_dev
         self.pop_size = max(quantum, (pop_size // quantum) * quantum)
         self.pairs_per_dev = self.pop_size // quantum
-        # Pallas fused-noise path: regenerate eps instead of storing it
-        # (fiber_tpu/ops/pallas_es.py). "auto" resolves to OFF: the
-        # fused-program A/B on the chip (bench.py --ab-pallas, recorded
-        # in RUNS/bench_tpu_success.json) measured the pallas path ~30x
-        # slower end-to-end at bench shapes — the custom-call grids
-        # serialize inside the rollout scan while XLA fuses the
-        # threefry noise into it, and HBM traffic was never the
-        # bottleneck here. An isolated kernel race mispredicts that
-        # (dispatch overhead dominates), so the default is simply the
-        # measured winner; pass use_pallas=True to force the kernels
-        # (they remain correctness-validated on hardware).
-        if use_pallas == "auto":
-            self.use_pallas = False
-        else:
-            self.use_pallas = bool(use_pallas)
-        # NOTE: pairs_per_dev is NOT rounded up to the pallas
-        # PAIR_BLOCK. Alignment would give the kernel's zero-repack
-        # fast path, but inflating the population multiplies rollout
-        # cost (the dominant term for this library's eval_fns) by up
-        # to PAIR_BLOCK×/device on small-pop configs — one padded
-        # (pop, dim) HBM repack is far cheaper. Pops that are already
-        # PAIR_BLOCK-aligned per device take the fast path naturally.
+        # Noise is plain jax.random.normal: a Pallas fused-noise
+        # experiment (regenerate eps instead of storing it) lived here
+        # through round 4 but the on-chip fused-program A/B measured it
+        # ~30x SLOWER end-to-end at bench shapes (custom-call grids
+        # serialize inside the rollout scan while XLA fuses threefry
+        # noise into it; HBM was never the bottleneck) — deleted in
+        # round 5 on that standing record (`git log -- fiber_tpu/ops/
+        # pallas_es.py` has the kernels).
         self._step = self._build_step()
 
     # ------------------------------------------------------------------
@@ -217,16 +202,6 @@ class EvolutionStrategy(_FusedRunMixin):
         pop = self.pop_size
         dim = self.dim
 
-        use_pallas = self.use_pallas
-        if use_pallas:
-            from fiber_tpu.ops.pallas_es import (
-                build_perturb,
-                build_weighted_eps_sum,
-            )
-
-            perturb_fn = build_perturb(pairs, dim, sigma)
-            wsum_fn = build_weighted_eps_sum(pairs, dim)
-
         adam = self.optimizer == "adam"
 
         def device_step(params, m, v, t, key):
@@ -237,20 +212,10 @@ class EvolutionStrategy(_FusedRunMixin):
             dev_key = jax.random.fold_in(key, my)
             eps_key, eval_key = jax.random.split(dev_key)
 
-            if use_pallas:
-                # Fused on-chip noise: eps never materializes in HBM; the
-                # gradient pass regenerates it from the same seed (two
-                # 31-bit words — one word birthday-collides across big
-                # meshes and long runs).
-                seed = jax.random.randint(
-                    eps_key, (2,), 0, 2**31 - 1, dtype=jnp.int32
-                )
-                thetas = perturb_fn(params, seed)       # (2*pairs, dim)
-            else:
-                eps = jax.random.normal(eps_key, (pairs, dim))
-                thetas = jnp.concatenate(
-                    [params + sigma * eps, params - sigma * eps], axis=0
-                )  # (2*pairs, dim)
+            eps = jax.random.normal(eps_key, (pairs, dim))
+            thetas = jnp.concatenate(
+                [params + sigma * eps, params - sigma * eps], axis=0
+            )  # (2*pairs, dim)
             eval_keys = jax.random.split(eval_key, 2 * pairs)
             fitness = jax.vmap(eval_fn)(thetas, eval_keys)  # (2*pairs,)
 
@@ -262,10 +227,7 @@ class EvolutionStrategy(_FusedRunMixin):
             my_ranks = ranks[my]                       # (2*pairs,)
             w = my_ranks[:pairs] - my_ranks[pairs:]    # antithetic weights
 
-            if use_pallas:
-                g_local = wsum_fn(w, seed)             # regenerated eps
-            else:
-                g_local = w @ eps                      # (dim,) on the MXU
+            g_local = w @ eps                          # (dim,) on the MXU
             grad = jax.lax.psum(g_local, "pool") / (pop * sigma)
             # Optimizer state is replicated like params; the update
             # math is the shared apply_es_update (one copy, also used

@@ -185,9 +185,6 @@ def harvest() -> None:
          bench + ["--attention", "--seq", "65536"], 300, None),
         ("lm train bench (MFU)",
          bench + ["--lm", "--seq", "8192"], 300, None),
-        ("pallas A/B (pallas_es keep-or-delete decision)",
-         bench + ["--ab-pallas", "--no-pool-bench", "--gens", "8"],
-         300, None),
         ("ES bench (pool leg rides along)", list(bench), 300, None),
         ("POET bench", bench + ["--poet"], 300, None),
         ("pixel bench",

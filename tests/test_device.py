@@ -276,57 +276,6 @@ def test_conv_policy_pixel_rollout():
     assert np.isfinite(float(jax.device_get(reward)))
 
 
-def test_pallas_es_kernels_interpret_plumbing():
-    """Pallas ES kernels: antithetic symmetry + regeneration consistency
-    in TPU interpret mode (true RNG bits need hardware; the runtime
-    self-check gates the real path — pallas_available() is False here)."""
-    import jax.numpy as jnp
-    from jax.experimental.pallas import tpu as pltpu
-
-    from fiber_tpu.ops.pallas_es import (
-        build_perturb,
-        build_weighted_eps_sum,
-        pallas_available,
-    )
-
-    assert pallas_available() is False  # cpu platform
-
-    pairs, dim, sigma = 8, 600, 0.1
-    ip = pltpu.InterpretParams()
-    seed = jnp.asarray([77, 31], jnp.int32)
-    params = jnp.linspace(0, 1, dim, dtype=jnp.float32)
-    thetas = build_perturb(pairs, dim, sigma, interpret=ip)(params, seed)
-    assert thetas.shape == (2 * pairs, dim)
-    eps_plus = (thetas[:pairs] - params) / sigma
-    eps_minus = (params - thetas[pairs:]) / sigma
-    assert jnp.allclose(eps_plus, eps_minus, atol=1e-5)
-    # Guard against fully-degenerate RNG making these checks vacuous
-    # (interpret mode gives constant — but nonzero — noise today).
-    assert float(jnp.abs(eps_plus).mean()) > 1e-3
-
-    w = jnp.linspace(-1, 1, pairs)
-    g = build_weighted_eps_sum(pairs, dim, interpret=ip)(w, seed)
-    assert jnp.allclose(g, w @ eps_plus, atol=1e-3)
-
-
-def test_es_use_pallas_flag_fallback():
-    """use_pallas='auto' on CPU falls back to the jnp path and still
-    trains."""
-    import jax
-
-    policy = MLPPolicy(CartPole.obs_dim, CartPole.act_dim, hidden=(8,))
-
-    def eval_fn(p, k):
-        return CartPole.rollout(policy.act, p, k, max_steps=50)
-
-    es = EvolutionStrategy(eval_fn, dim=policy.dim, pop_size=16,
-                           use_pallas="auto")
-    assert es.use_pallas is False
-    params = policy.init(jax.random.PRNGKey(0))
-    _, stats = es.step(params, jax.random.PRNGKey(1))
-    assert np.all(np.isfinite(np.asarray(jax.device_get(stats))))
-
-
 def test_ring_attention_matches_reference():
     """Exact attention with the sequence sharded over 8 devices equals the
     full-matrix reference, causal and non-causal."""

@@ -225,6 +225,11 @@ struct Device {
   std::atomic<int> n_in{0}, n_out{0};
   std::vector<uint8_t> key;  // empty = handshake disabled
   int n_unauthed = 0;        // flood guard (matches tcp.py's 64-slot cap)
+  // Pre-auth admission order for O(1) evict-oldest: conn IDS (never
+  // reused, unlike fds) pushed at admit, lazily skipped once the conn
+  // authed or died. Scanning all of d->conns per accept would make a
+  // sustained flood cost O(total peers) on the one event-loop thread.
+  std::deque<uint64_t> preauth_fifo;
   std::thread thr;
 };
 
@@ -459,8 +464,29 @@ void on_accept(Device* d, int listen_fd, bool in_side) {
     int fd = accept4(listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
     if (fd < 0) return;
     if (!d->key.empty() && d->n_unauthed >= kMaxUnauthed) {
-      ::close(fd);  // flood: refuse rather than accumulate pre-auth state
-      continue;
+      // EVICT-OLDEST (matches tcp.py / utils/serve.py): drop the
+      // earliest-admitted still-unauthenticated peer and admit the
+      // newcomer — refusing the newcomer would let kMaxUnauthed idle
+      // holders lock every legitimate peer out for a full
+      // kAuthTimeout window while total pre-auth state stays bounded
+      // either way. The FIFO holds conn ids (never reused) and skips
+      // entries whose conn authed or died since admission.
+      int victim_fd = -1;
+      while (!d->preauth_fifo.empty()) {
+        uint64_t id = d->preauth_fifo.front();
+        d->preauth_fifo.pop_front();
+        auto vit = d->conns_by_id.find(id);
+        if (vit != d->conns_by_id.end() && !vit->second->authed) {
+          victim_fd = vit->second->fd;
+          break;
+        }
+      }
+      if (victim_fd >= 0) {
+        drop_conn(d, victim_fd);
+      } else {
+        ::close(fd);  // count said full but no victim found; stay safe
+        continue;
+      }
     }
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
@@ -480,6 +506,7 @@ void on_accept(Device* d, int listen_fd, bool in_side) {
       // challenge first; the peer joins the forwarding lists only after
       // handle_frame verifies its response
       d->n_unauthed++;
+      d->preauth_fifo.push_back(c->id);
       c->auth_deadline = std::chrono::steady_clock::now() + kAuthTimeout;
       fill_random(c->nonce, kNonceLen);
       queue_write(d, c, auth_frame(c->nonce, kNonceLen));

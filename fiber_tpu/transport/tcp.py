@@ -190,7 +190,14 @@ class Endpoint:
         self._rr = 0
         self._listener: Optional[pysocket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
-        self._handshake_slots = threading.BoundedSemaphore(64)
+        # Unauthenticated dialers mid-handshake, oldest first. Flood
+        # posture is EVICT-OLDEST (same as fiber_tpu/utils/serve.py):
+        # at the cap the oldest holder is shut down to admit the new
+        # arrival — drop-newest would let idle holders lock real peers
+        # out for a whole handshake-timeout window.
+        self._preauth: List[pysocket.socket] = []
+        self._preauth_cap = 64
+        self._preauth_lock = threading.Lock()
         self._closed = False
         self._reply_to: Optional[_Channel] = None
         self.addr: Optional[str] = None
@@ -258,15 +265,25 @@ class Endpoint:
                 return
             if auth.auth_enabled():
                 # Handshake off-thread: a slow or hostile dialer must not
-                # stall accepts for legitimate peers. Bounded — past the
-                # cap, new dialers are dropped instead of accumulating
-                # 20s-timeout threads (connection-flood hardening).
-                if not self._handshake_slots.acquire(blocking=False):
+                # stall accepts for legitimate peers. At the cap the
+                # OLDEST unauthenticated holder is evicted (shutdown
+                # wakes its blocked recv with EOF; its thread cleans up)
+                # so a standing flood cannot lock legitimate peers out.
+                with self._preauth_lock:
+                    # POP the victim inside the lock: leaving it listed
+                    # would make the cap advisory (every arrival would
+                    # "evict" the same dead socket while appending
+                    # itself), and its absence from the list is how a
+                    # completed handshake knows it was evicted.
+                    evict = (self._preauth.pop(0)
+                             if len(self._preauth) >= self._preauth_cap
+                             else None)
+                    self._preauth.append(sock)
+                if evict is not None:
                     try:
-                        sock.close()
+                        evict.shutdown(pysocket.SHUT_RDWR)
                     except OSError:
                         pass
-                    continue
                 threading.Thread(
                     target=self._authenticate_and_add, args=(sock,),
                     name="fiber-ep-auth", daemon=True,
@@ -280,13 +297,29 @@ class Endpoint:
         except (OSError, auth.AuthenticationError) as err:
             logger.warning("rejecting unauthenticated data-plane peer: %s",
                            err)
+            with self._preauth_lock:
+                try:
+                    self._preauth.remove(sock)
+                except ValueError:
+                    pass  # already evicted
             try:
                 sock.close()
             except OSError:
                 pass
             return
-        finally:
-            self._handshake_slots.release()
+        # Success — promote ONLY if the evictor didn't pop us while the
+        # handshake was finishing (its shutdown may land any moment; a
+        # channel built on that socket would die confusingly mid-use).
+        with self._preauth_lock:
+            evicted = sock not in self._preauth
+            if not evicted:
+                self._preauth.remove(sock)
+        if evicted:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
         self._add_channel(sock)
 
     def _add_channel(self, sock: pysocket.socket) -> None:

@@ -135,17 +135,19 @@ def serve_authenticated(listener, authkey: bytes,
     gate = threading.Lock()
 
     def guarded(conn) -> None:
-        try:
-            ok = authenticate(
-                conn, authkey,
-                deadline if deadline is not None else HANDSHAKE_DEADLINE)
-        finally:
-            with gate:
-                try:
-                    pending.remove(conn)
-                except ValueError:
-                    pass
-        if not ok:
+        ok = authenticate(
+            conn, authkey,
+            deadline if deadline is not None else HANDSHAKE_DEADLINE)
+        # Removal from `pending` doubles as the eviction signal: the
+        # evictor POPS its victim under the gate, so "already absent"
+        # after a successful handshake means the evictor's _force_eof
+        # may land any moment — promoting that conn would hand the
+        # handler a socket about to EOF mid-use.
+        with gate:
+            evicted = conn not in pending
+            if not evicted:
+                pending.remove(conn)
+        if not ok or evicted:
             try:
                 conn.close()
             except OSError:
@@ -162,7 +164,11 @@ def serve_authenticated(listener, authkey: bytes,
             time.sleep(0.05)
             continue
         with gate:
-            evict = pending[0] if len(pending) >= preauth_cap else None
+            # POP inside the gate: leaving the victim listed would make
+            # the cap advisory (every arrival would "evict" the same
+            # dead conn while appending itself).
+            evict = (pending.pop(0) if len(pending) >= preauth_cap
+                     else None)
             pending.append(conn)
         if evict is not None:
             _force_eof(evict)  # its guarded() thread fails fast + cleans up

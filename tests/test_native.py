@@ -85,3 +85,45 @@ def test_native_pump_rejects_wrong_key():
         good.close()
     finally:
         pump.close()
+
+
+@needs_gxx
+def test_native_pump_flood_evicts_oldest_not_newest():
+    """C++ pump flood posture mirrors the Python planes: with
+    kMaxUnauthed (128) idle holders parked mid-handshake, a legitimate
+    producer/consumer pair connecting over the flood still delivers —
+    the pump evicts the oldest unauthenticated peer rather than
+    refusing the newcomers."""
+    import socket as pysocket
+    import time
+
+    from fiber_tpu.transport.tcp import Device, Endpoint, parse_addr
+
+    device = Device("r", "w", "127.0.0.1")
+    assert device._native is not None, "native pump not engaged"
+    host, in_port = parse_addr(device.in_addr)
+    holders = []
+    try:
+        for _ in range(130):  # kMaxUnauthed=128, +2 forces evictions
+            holders.append(
+                pysocket.create_connection((host, in_port), 5))
+        time.sleep(0.3)
+        writer = Endpoint("w").connect(device.in_addr)
+        reader = Endpoint("r").connect(device.out_addr)
+        got = []
+        t = __import__("threading").Thread(
+            target=lambda: got.append(reader.recv(15)))
+        t.start()
+        time.sleep(0.1)  # reader grants credit first (demand-driven)
+        writer.send(b"through the native flood")
+        t.join(20)
+        assert got == [b"through the native flood"]
+        writer.close()
+        reader.close()
+    finally:
+        for h in holders:
+            try:
+                h.close()
+            except OSError:
+                pass
+        device.close()

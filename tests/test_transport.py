@@ -280,3 +280,35 @@ def test_prefetch_window_streams_through_device():
     reader.close()
     reader2.close()
     device.close()
+
+
+def test_endpoint_flood_evicts_oldest_not_newest():
+    """Data-plane flood posture: when the pre-auth cap is full of idle
+    holders, the OLDEST is evicted so a legitimate peer connecting over
+    the standing flood still authenticates (drop-newest would lock it
+    out for a whole handshake-timeout window)."""
+    import socket as pysocket
+    import time
+
+    ep = Endpoint("r")
+    # tiny cap so the test floods with 6 sockets, not 65
+    ep._preauth_cap = 4
+    addr = ep.bind("127.0.0.1")
+    host, port = addr[len("tcp://"):].rsplit(":", 1)
+    holders = []
+    try:
+        for _ in range(6):
+            holders.append(
+                pysocket.create_connection((host, int(port)), 5))
+        time.sleep(0.2)  # all six accepted; last four hold the slots
+        sender = Endpoint("w").connect(addr)  # evicts the oldest holder
+        sender.send(b"through the flood")
+        assert ep.recv(10) == b"through the flood"
+        sender.close()
+    finally:
+        for h in holders:
+            try:
+                h.close()
+            except OSError:
+                pass
+        ep.close()

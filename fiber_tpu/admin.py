@@ -53,15 +53,25 @@ class AdminServer:
     def __init__(self, ip: str, port: int) -> None:
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # Bind the advertised interface ONLY (same posture as the
+        # managers/data planes): a wildcard bind exposed the
+        # pickle-shipping admin channel on every NIC even for
+        # loopback-only backends.
         if port:
-            self._listener.bind(("", port))
+            self._listener.bind((ip, port))
             self.port = port
         else:
-            _, self.port = random_port_bind(self._listener)
+            _, self.port = random_port_bind(self._listener, host=ip)
         self.ip = ip
         self._listener.listen(256)
         self._waiters: Dict[int, Waiter] = {}
         self._lock = threading.Lock()
+        # Connections that have not yet sent their ident: the shared
+        # evict-oldest pool (fiber_tpu/utils/serve.py PreauthPool
+        # documents the protocol).
+        from fiber_tpu.utils.serve import PreauthPool
+
+        self._preident = PreauthPool(64)
         self._thread = threading.Thread(
             target=self._accept_loop, name="fiber-admin", daemon=True
         )
@@ -110,6 +120,16 @@ class AdminServer:
                 conn, addr = self._listener.accept()
             except OSError:
                 return  # listener closed
+            # Evict-oldest at the cap: hostile connect-and-hold dialers
+            # must neither grow threads unboundedly nor lock a real
+            # worker's connect-back out (shutdown wakes the victim's
+            # blocked recv with EOF).
+            evict = self._preident.admit(conn)
+            if evict is not None:
+                try:
+                    evict.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
             threading.Thread(
                 target=self._handshake,
                 args=(conn, addr),
@@ -132,19 +152,30 @@ class AdminServer:
             (ident,) = _IDENT.unpack(data)
             conn.settimeout(None)
         except OSError as exc:
-            # Never silent: this close RESETS the dialing worker (it dies
-            # at prep recv with ECONNRESET and the launcher then reports
-            # "exited before connecting back" with no cause in sight) —
-            # the log line is the only place the real reason survives.
-            logger.warning("admin: ident handshake from %s failed: %r",
-                           addr, exc)
+            if not self._preident.complete(conn):
+                # Never silent for REAL peers: this close RESETS the
+                # dialing worker (it dies at prep recv with ECONNRESET
+                # and the launcher reports "exited before connecting
+                # back" with no cause in sight) — the log line is the
+                # only place the real reason survives. Evicted flood
+                # holders fail by design and are not logged (one line
+                # per hostile connection would amplify the flood into
+                # the log and bury the real diagnostic).
+                logger.warning("admin: ident handshake from %s failed: "
+                               "%r", addr, exc)
+            conn.close()
+            return
+        if self._preident.complete(conn):
+            # Evicted while the ident was in flight — the evictor's
+            # shutdown may land any moment; the waiter must not be
+            # handed this socket.
             conn.close()
             return
         with self._lock:
             waiter = self._waiters.pop(ident, None)
         if waiter is None:
-            logger.warning("admin: unexpected connect-back ident=%s from %s",
-                           ident, addr)
+            logger.warning("admin: unexpected connect-back ident=%s "
+                           "from %s", ident, addr)
             conn.close()
             return
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)

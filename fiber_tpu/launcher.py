@@ -37,15 +37,16 @@ from fiber_tpu.utils.logging import get_logger
 logger = get_logger()
 
 
-_ident_lock = threading.Lock()
-_ident_counter = int.from_bytes(os.urandom(6), "big")
-
-
 def next_launch_ident() -> int:
-    global _ident_counter
-    with _ident_lock:
-        _ident_counter += 1
-        return _ident_counter
+    """Unguessable 64-bit capability token for one launch: the worker
+    proves it is the process we launched by echoing it on connect-back.
+    Sequential idents — even from a random starting point — would let
+    a network peer who ever learns one predict every later one and
+    race the real worker for the master's pickled process state; fully
+    random per-launch idents make the connect-back a bearer
+    capability. (Collision odds across a master's lifetime are ~2^-64
+    per pair — ignorable.)"""
+    return int.from_bytes(os.urandom(8), "big") or 1
 
 
 def get_pid_from_jid(jid: Any) -> int:
@@ -90,8 +91,6 @@ class JobLauncher:
             sys.executable,
             "-m",
             "fiber_tpu.worker",
-            "--ident",
-            str(ident),
         ]
         if active:
             cmd += ["--master", master_addr]
@@ -99,6 +98,10 @@ class JobLauncher:
             cmd += ["--listen", str(cfg.ipc_admin_worker_port)]
 
         spec = self._job_spec(process_obj, cmd)
+        # The ident rides the job ENV, never argv: /proc/<pid>/cmdline
+        # is world-readable on shared hosts, and the ident is the
+        # bearer capability for the master's pickled process state.
+        spec.env["FIBER_LAUNCH_IDENT"] = str(ident)
         try:
             self.job = self.backend.create_job(spec)
         except Exception:

@@ -190,14 +190,13 @@ class Endpoint:
         self._rr = 0
         self._listener: Optional[pysocket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
-        # Unauthenticated dialers mid-handshake, oldest first. Flood
-        # posture is EVICT-OLDEST (same as fiber_tpu/utils/serve.py):
-        # at the cap the oldest holder is shut down to admit the new
-        # arrival — drop-newest would let idle holders lock real peers
-        # out for a whole handshake-timeout window.
-        self._preauth: List[pysocket.socket] = []
-        self._preauth_cap = 64
-        self._preauth_lock = threading.Lock()
+        # Unauthenticated dialers mid-handshake: the shared evict-oldest
+        # pool (fiber_tpu/utils/serve.py PreauthPool documents the
+        # protocol — drop-newest would let idle holders lock real peers
+        # out for a whole handshake-timeout window).
+        from fiber_tpu.utils.serve import PreauthPool
+
+        self._preauth = PreauthPool(64)
         self._closed = False
         self._reply_to: Optional[_Channel] = None
         self.addr: Optional[str] = None
@@ -269,16 +268,7 @@ class Endpoint:
                 # OLDEST unauthenticated holder is evicted (shutdown
                 # wakes its blocked recv with EOF; its thread cleans up)
                 # so a standing flood cannot lock legitimate peers out.
-                with self._preauth_lock:
-                    # POP the victim inside the lock: leaving it listed
-                    # would make the cap advisory (every arrival would
-                    # "evict" the same dead socket while appending
-                    # itself), and its absence from the list is how a
-                    # completed handshake knows it was evicted.
-                    evict = (self._preauth.pop(0)
-                             if len(self._preauth) >= self._preauth_cap
-                             else None)
-                    self._preauth.append(sock)
+                evict = self._preauth.admit(sock)
                 if evict is not None:
                     try:
                         evict.shutdown(pysocket.SHUT_RDWR)
@@ -295,13 +285,11 @@ class Endpoint:
         try:
             auth.server_handshake(sock)
         except (OSError, auth.AuthenticationError) as err:
-            logger.warning("rejecting unauthenticated data-plane peer: %s",
-                           err)
-            with self._preauth_lock:
-                try:
-                    self._preauth.remove(sock)
-                except ValueError:
-                    pass  # already evicted
+            if not self._preauth.complete(sock):
+                # Evicted holders fail by design — logging each would
+                # amplify a flood into the log.
+                logger.warning(
+                    "rejecting unauthenticated data-plane peer: %s", err)
             try:
                 sock.close()
             except OSError:
@@ -310,11 +298,7 @@ class Endpoint:
         # Success — promote ONLY if the evictor didn't pop us while the
         # handshake was finishing (its shutdown may land any moment; a
         # channel built on that socket would die confusingly mid-use).
-        with self._preauth_lock:
-            evicted = sock not in self._preauth
-            if not evicted:
-                self._preauth.remove(sock)
-        if evicted:
+        if self._preauth.complete(sock):
             try:
                 sock.close()
             except OSError:

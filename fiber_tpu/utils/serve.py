@@ -77,6 +77,51 @@ def _force_eof(conn) -> None:
     _on_description(conn, lambda s: s.shutdown(socket.SHUT_RDWR))
 
 
+class PreauthPool:
+    """The bounded evict-oldest pool of not-yet-authenticated
+    connections, shared by every listening plane (agent/manager RPC,
+    the data-plane Python acceptor, the admin connect-back listener).
+
+    Protocol (concurrency-sensitive — keep the three rules together):
+    1. ``admit(conn)`` appends under the lock and, at the cap, POPS the
+       oldest as the victim (leaving it listed would make the cap
+       advisory: every arrival would re-evict the same dead conn while
+       appending itself). The caller wakes the victim — via
+       ``shutdown(2)`` on the object or a dup'd fd, never a
+       cross-thread ``close`` (fd-reuse race) — OUTSIDE the lock.
+    2. ``complete(conn)`` removes the conn and reports whether it had
+       already been evicted: absence IS the eviction signal, and a
+       handshake that finished in a photo-finish with its own eviction
+       must NOT be promoted (the victim-waker may land any moment).
+    3. Only the admitting thread and the conn's own handshake thread
+       touch a given conn's entry, so pop/remove cannot double-fire.
+    """
+
+    def __init__(self, cap: int = DEFAULT_PREAUTH_CAP) -> None:
+        self._pending: list = []
+        self._cap = cap
+        self._lock = threading.Lock()
+
+    def admit(self, conn):
+        """Register ``conn``; returns the evicted oldest holder (wake
+        it, outside any lock) or None."""
+        with self._lock:
+            evict = (self._pending.pop(0)
+                     if len(self._pending) >= self._cap else None)
+            self._pending.append(conn)
+        return evict
+
+    def complete(self, conn) -> bool:
+        """Deregister ``conn`` after its handshake attempt; True if it
+        was evicted while the handshake was in flight (do not promote,
+        do not log it as a peer failure)."""
+        with self._lock:
+            if conn in self._pending:
+                self._pending.remove(conn)
+                return False
+            return True
+
+
 def authenticate(conn, authkey: bytes,
                  deadline: float = HANDSHAKE_DEADLINE) -> bool:
     """Run the mutual HMAC challenge with hard time bounds; True on
@@ -126,27 +171,15 @@ def serve_authenticated(listener, authkey: bytes,
     retried after a short sleep so one bad accept can't kill the
     plane).
 
-    Flood posture is EVICT-OLDEST, not drop-newest: when the cap is
-    reached, the oldest still-unauthenticated connection is forcibly
-    EOF'd to free its slot and the new arrival is served. Dropping
-    the newcomer instead would let ``cap`` idle holders lock every
-    legitimate client out for a whole handshake-deadline window."""
-    pending: list = []  # unauthenticated conns, oldest first
-    gate = threading.Lock()
+    Flood posture is EVICT-OLDEST, not drop-newest (see
+    :class:`PreauthPool` for the protocol and its invariants)."""
+    pool = PreauthPool(preauth_cap)
 
     def guarded(conn) -> None:
         ok = authenticate(
             conn, authkey,
             deadline if deadline is not None else HANDSHAKE_DEADLINE)
-        # Removal from `pending` doubles as the eviction signal: the
-        # evictor POPS its victim under the gate, so "already absent"
-        # after a successful handshake means the evictor's _force_eof
-        # may land any moment — promoting that conn would hand the
-        # handler a socket about to EOF mid-use.
-        with gate:
-            evicted = conn not in pending
-            if not evicted:
-                pending.remove(conn)
+        evicted = pool.complete(conn)
         if not ok or evicted:
             try:
                 conn.close()
@@ -163,13 +196,7 @@ def serve_authenticated(listener, authkey: bytes,
                 break
             time.sleep(0.05)
             continue
-        with gate:
-            # POP inside the gate: leaving the victim listed would make
-            # the cap advisory (every arrival would "evict" the same
-            # dead conn while appending itself).
-            evict = (pending.pop(0) if len(pending) >= preauth_cap
-                     else None)
-            pending.append(conn)
+        evict = pool.admit(conn)
         if evict is not None:
             _force_eof(evict)  # its guarded() thread fails fast + cleans up
         threading.Thread(target=guarded, args=(conn,),

@@ -176,20 +176,29 @@ def _listen_passive(port: int, ident: int) -> socket.socket:
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="fiber_tpu.worker")
-    parser.add_argument("--ident", type=int, required=True)
+    # The ident is a bearer capability and rides the job ENVIRONMENT
+    # (FIBER_LAUNCH_IDENT): on argv it would be world-readable via
+    # /proc/<pid>/cmdline on shared worker hosts, letting any local
+    # observer race us for the master's pickled process state. The
+    # flag remains for tooling but the env is canonical.
+    parser.add_argument("--ident", type=int, default=0)
     parser.add_argument("--master", default="")
     parser.add_argument("--listen", type=int, default=0)
     args = parser.parse_args(argv)
+    ident = args.ident or int(os.environ.get("FIBER_LAUNCH_IDENT", "0"))
+    if not ident:
+        parser.error("need FIBER_LAUNCH_IDENT in the environment "
+                     "(or --ident)")
 
     if args.master:
         try:
-            conn = _connect_active(args.master, args.ident)
+            conn = _connect_active(args.master, ident)
         except OSError:
             # Master vanished between job creation and our dial-in (e.g.
             # pool shutdown race) — nothing to report to anyone.
             return 1
     elif args.listen:
-        conn = _listen_passive(args.listen, args.ident)
+        conn = _listen_passive(args.listen, ident)
     else:
         parser.error("need --master (active) or --listen (passive)")
 

@@ -208,3 +208,50 @@ def test_transport_works_past_1024_fds():
             resource.setrlimit(resource.RLIMIT_NOFILE, (soft, hard))
         except (ValueError, OSError):
             pass
+
+
+def test_launch_idents_are_unguessable():
+    """Connect-back idents are bearer capabilities: fully random 64-bit
+    per launch, never sequential (a peer who learns one must not be
+    able to predict the next and race a worker for the master's
+    pickled process state), and they ride the job environment rather
+    than argv (world-readable /proc/<pid>/cmdline)."""
+    from fiber_tpu.launcher import next_launch_ident
+
+    a, b, c = (next_launch_ident() for _ in range(3))
+    assert len({a, b, c}) == 3
+    assert b != a + 1 and c != b + 1  # sequential would be predictable
+    assert max(a, b, c) > 2**40       # actually drawing from 64 bits
+
+
+def test_admin_plane_survives_hostile_clients():
+    """The admin connect-back listener (the fourth listening plane)
+    under hostile traffic: bare connect-close, garbage idents, and a
+    connect-and-hold socket must neither kill the accept loop nor
+    block a real launch happening over the flood."""
+    import socket as pysocket
+    import struct
+
+    from fiber_tpu.admin import AdminServer
+
+    admin = AdminServer.ensure("127.0.0.1")
+    port = admin.port
+    holders = []
+    try:
+        for _ in range(3):
+            pysocket.create_connection(("127.0.0.1", port), 5).close()
+        bad = pysocket.create_connection(("127.0.0.1", port), 5)
+        bad.sendall(struct.pack(">Q", 0xDEADBEEF))  # unknown ident
+        bad.close()
+        holders.append(pysocket.create_connection(("127.0.0.1", port), 5))
+        # a real launch must still complete while the holder sits there
+        p = fiber_tpu.Process(target=targets.noop)
+        p.start()
+        p.join(60)
+        assert p.exitcode == 0
+    finally:
+        for h in holders:
+            try:
+                h.close()
+            except OSError:
+                pass

@@ -33,7 +33,7 @@ bench:
 # population scaled with devices) + strong curve (constant total pop)
 # -> RUNS/weak_scaling_r05.json. On chip the same entry records real scaling.
 weakscale:
-	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	JAX_PLATFORMS=cpu python __graft_entry__.py --weak-scaling
 
 lint:

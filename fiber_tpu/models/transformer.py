@@ -456,4 +456,37 @@ def make_train_step(model: TinyLM, optimizer, batched: bool = False):
             lambda p, u: p + u, params, updates)
         return params, opt_state, loss
 
-    return jax.jit(step)
+    jitted = jax.jit(step)
+    if _needs_cpu_collective_serialization(model):
+        # XLA CPU's in-process collectives can DEADLOCK when jax's
+        # async dispatch interleaves two step-generations over the CPU
+        # client's fixed thread pool: step k+1's per-device programs
+        # park in their first rendezvous on threads step k's last
+        # rendezvous still needs (core-dump-verified on the 1-core dev
+        # box, RUNS/stest_abort_repro.md). Serializing steps on a CPU
+        # mesh closes the window and costs nothing measurable there
+        # (compute-bound); real TPU keeps full async dispatch.
+        def step_sync(params, opt_state, tokens):
+            out = jitted(params, opt_state, tokens)
+            jax.block_until_ready(out)
+            return out
+
+        return step_sync
+    return jitted
+
+
+def _needs_cpu_collective_serialization(model) -> bool:
+    """True when training steps run collectives across >1 virtual CPU
+    device — the configuration where pipelined generations can
+    deadlock XLA's in-process rendezvous (see make_train_step). The
+    EFFECTIVE mesh matters: with ``mesh=None`` the ring/ulysses planes
+    resolve the process-wide default mesh (all devices) at attend
+    time, so a bare ``TinyLM(attention="ring")`` still runs 8-device
+    collectives on the virtual CPU plane."""
+    from fiber_tpu.parallel.mesh import default_mesh, is_multidevice_cpu
+
+    mesh = getattr(model, "_mesh", None)
+    if mesh is None and getattr(model, "attention", "") in (
+            "ring", "ulysses"):
+        mesh = default_mesh()
+    return is_multidevice_cpu(mesh)

@@ -217,6 +217,9 @@ class _CMABase(_FusedRunMixin):
         """One generation: ``(state, stats)`` with stats =
         [mean_fitness, max_fitness, sigma]."""
         out = self._step(*state, key)
+        from fiber_tpu.parallel.mesh import cpu_step_barrier
+
+        cpu_step_barrier(self.mesh, out[-1])
         return out[:-1], out[-1]
 
     def run(self, state, key, generations: int):

@@ -309,6 +309,9 @@ class EvolutionStrategy(_FusedRunMixin):
         new_params, m, v, t, stats = self._step(params, m, v, t, key)
         if self.optimizer == "adam":
             self._opt_state = (m, v, t)
+        from fiber_tpu.parallel.mesh import cpu_step_barrier
+
+        cpu_step_barrier(self.mesh, (new_params, stats))
         return new_params, stats
 
     def run(self, params, key, generations: int,

@@ -228,6 +228,9 @@ class MAPElites:
         mean_child_fitness]."""
         genomes, fitness, behaviors, stats = self._step(
             state.genomes, state.fitness, state.behaviors, key)
+        from fiber_tpu.parallel.mesh import cpu_step_barrier
+
+        cpu_step_barrier(self.mesh, (genomes, stats))
         return MapElitesState(genomes, fitness, behaviors), stats
 
     def run(self, state: MapElitesState, key, generations: int):

@@ -277,6 +277,9 @@ class NoveltyES(_FusedRunMixin):
             state.params, state.archive, state.count,
             state.w, state.best, state.stag, key,
         )
+        from fiber_tpu.parallel.mesh import cpu_step_barrier
+
+        cpu_step_barrier(self.mesh, (params, stats))
         return NoveltyState(params, archive, count, w, best, stag), stats
 
     def run(self, state: NoveltyState, key, generations: int):

@@ -132,6 +132,9 @@ class PGPE(_FusedRunMixin):
         [mean_fitness, max_fitness, mean_sigma]."""
         mu, sigma = state
         new_mu, new_sigma, stats = self._step(mu, sigma, key)
+        from fiber_tpu.parallel.mesh import cpu_step_barrier
+
+        cpu_step_barrier(self.mesh, (new_mu, stats))
         return (new_mu, new_sigma), stats
 
     def run(self, state, key, generations: int):

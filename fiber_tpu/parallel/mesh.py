@@ -56,6 +56,38 @@ def default_mesh():
         return _default_mesh
 
 
+def is_multidevice_cpu(mesh) -> bool:
+    """True when ``mesh`` spans >1 CPU device — the configuration where
+    XLA's in-process collective rendezvous can DEADLOCK if async
+    dispatch interleaves two program generations over the CPU client's
+    fixed thread pool (core-dump-verified on the 1-core dev box,
+    RUNS/stest_abort_repro.md). Decides on the mesh's OWN devices, not
+    the default backend: an explicit CPU mesh under an accelerator
+    default must still count."""
+    if mesh is None or getattr(mesh, "size", 1) <= 1:
+        return False
+    try:
+        dev = mesh.devices.flat[0]
+    except (AttributeError, IndexError):
+        return False
+    return getattr(dev, "platform", "") == "cpu"
+
+
+def cpu_step_barrier(mesh, out) -> None:
+    """Serialize multi-step Python loops on a multi-device CPU mesh:
+    ``block_until_ready(out)`` so only ONE program generation is ever
+    in flight (collective thunks block their pool threads in the
+    rendezvous; a second interleaved generation can exhaust the pool —
+    mutual waiting, then XLA's terminate-timeout abort). Costs nothing
+    measurable on CPU (compute-bound); a TPU mesh keeps async
+    dispatch. Every ES-family ``step()`` and ``make_train_step`` call
+    this; fused ``lax.scan`` drivers are structurally immune."""
+    if is_multidevice_cpu(mesh):
+        import jax
+
+        jax.block_until_ready(out)
+
+
 def reset_default_mesh() -> None:
     global _default_mesh
     with _lock:

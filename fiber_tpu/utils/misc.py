@@ -116,3 +116,36 @@ def package_pythonpath() -> str:
             pkg_root + os.pathsep + pythonpath if pythonpath else pkg_root
         )
     return pythonpath
+
+
+#: XLA CPU in-process collectives abort() the WHOLE interpreter via an
+#: absl FATAL when a rendezvous participant misses the terminate
+#: deadline (core-dump-verified cause of the round-4/5 sim-tier
+#: SIGABRT, RUNS/stest_abort_repro.md). The deadline exists because a
+#: missing participant IS possible — async dispatch can interleave two
+#: program generations over the CPU client's fixed thread pool (the
+#: library serializes its own multi-step CPU-mesh loops to close that
+#: window: make_train_step / EvolutionStrategy.step). These values
+#: widen the deadline enough that transient 1-core starvation never
+#: kills a healthy run (defaults are tens of seconds), while a REAL
+#: deadlock still dies in bounded time with XLA's message naming the
+#: rendezvous rather than hanging forever. cpu-backend flags, inert on
+#: real TPU.
+_CPU_COLLECTIVE_TIMEOUT_FLAGS = (
+    "--xla_cpu_collective_call_warn_stuck_timeout_seconds=120",
+    "--xla_cpu_collective_call_terminate_timeout_seconds=600",
+    "--xla_cpu_collective_timeout_seconds=600",
+)
+
+
+def ensure_cpu_collective_timeout_flags() -> None:
+    """Append the CPU-collective timeout policy to ``XLA_FLAGS`` —
+    per flag, and only where the caller has not already set that flag
+    (an explicit caller policy must win). Call BEFORE the first jax
+    backend initialization; every CPU-mesh entry point (test conftest,
+    the driver graft entry, record scripts) routes through here."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    added = [f for f in _CPU_COLLECTIVE_TIMEOUT_FLAGS
+             if f.split("=", 1)[0] not in flags]
+    if added:
+        os.environ["XLA_FLAGS"] = (flags + " " + " ".join(added)).strip()

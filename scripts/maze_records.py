@@ -52,6 +52,14 @@ def main() -> int:
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count=8"
             ).strip()
+        # Shared-core virtual mesh: a starved collective participant
+        # must be slow, not abort() the interpreter (see
+        # RUNS/stest_abort_repro.md).
+        from fiber_tpu.utils.misc import (
+            ensure_cpu_collective_timeout_flags,
+        )
+
+        ensure_cpu_collective_timeout_flags()
     try:
         jax.config.update("jax_platforms", platform)
     except Exception:

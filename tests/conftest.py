@@ -22,6 +22,19 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# ROOT CAUSE of the round-4/5 sim-tier SIGABRT (core-dump verified,
+# RUNS/stest_abort_repro.md): XLA CPU's in-process collective
+# rendezvous abort()s the whole process when a starved participant
+# thread misses its terminate deadline — on this ONE-core box a loaded
+# suite can starve any of the 8 virtual devices' threads. The shared
+# policy makes a starved collective a slow test, never a dead
+# interpreter.
+from fiber_tpu.utils.misc import (  # noqa: E402
+    ensure_cpu_collective_timeout_flags,
+)
+
+ensure_cpu_collective_timeout_flags()
 os.environ.setdefault("FIBER_BACKEND", "local")
 os.environ.setdefault("FIBER_LOG_FILE", "/tmp/fiber_tpu_test.log")
 

@@ -1493,3 +1493,27 @@ def test_ulysses_attention_local_composes_2d_data_seq_mesh():
     assert np.abs(got - want).max() < 1e-5
 
     _assert_2d_grad_parity(fn, q, k, v)
+
+
+def test_train_step_serializes_on_cpu_mesh():
+    """Multi-device CPU-mesh training steps must dispatch synchronously:
+    XLA CPU's in-process collective rendezvous can deadlock when async
+    dispatch interleaves two step generations over the client's fixed
+    thread pool (core-dump-verified, RUNS/stest_abort_repro.md). The
+    guard must also see the EFFECTIVE mesh — a bare ring/ulysses model
+    resolves the default mesh at attend time."""
+    import optax
+
+    from fiber_tpu.models import TinyLM, make_train_step
+    from fiber_tpu.models.transformer import (
+        _needs_cpu_collective_serialization,
+    )
+
+    ring = TinyLM(vocab=16, dim=32, heads=4, layers=1, max_seq=16,
+                  attention="ring")  # mesh=None -> default mesh
+    assert _needs_cpu_collective_serialization(ring)
+    assert make_train_step(ring, optax.adamw(1e-3)).__name__ \
+        == "step_sync"
+    single = TinyLM(vocab=16, dim=32, heads=4, layers=1, max_seq=16,
+                    attention="reference")
+    assert not _needs_cpu_collective_serialization(single)

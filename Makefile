@@ -3,7 +3,7 @@
 
 PYTEST ?= python -m pytest tests/ -q
 
-.PHONY: test stest test-all lint bench weakscale docs
+.PHONY: test stest test-all lint bench weakscale docs chaos
 
 # Tier 1: local backend (subprocess jobs)
 test:
@@ -22,6 +22,16 @@ stest:
 # then FIBER_BACKEND=tpu FIBER_TPU_HOSTS=host1,host2 make test
 
 test-all: test stest
+
+# Chaos tier (docs/robustness.md): the seeded fault-injection suite —
+# health-plane unit tests once, then the injection scenarios (including
+# the slow soaks) under three fixed seeds. The fast scenarios also run
+# un-marked in tier 1; this target is the full deterministic sweep.
+chaos:
+	python -m pytest tests/test_health.py -q
+	FIBER_CHAOS_SEED=101 python -m pytest tests/test_chaos.py -q
+	FIBER_CHAOS_SEED=202 python -m pytest tests/test_chaos.py -q
+	FIBER_CHAOS_SEED=303 python -m pytest tests/test_chaos.py -q
 
 # FIBER_BENCH_ENFORCE: fail loudly when the 1 ms host-pool point
 # drifts past its budget (the driver's plain `python bench.py` only

@@ -13,6 +13,7 @@ import weakref
 from typing import List, Optional, Tuple
 
 from fiber_tpu.core import Backend, Job, JobSpec, ProcessStatus
+from fiber_tpu.testing import chaos
 from fiber_tpu.utils.logging import get_logger
 
 logger = get_logger()
@@ -29,6 +30,12 @@ class LocalBackend(Backend):
     def create_job(self, job_spec: JobSpec) -> Job:
         import os
 
+        plan = chaos._plan
+        if plan is not None:
+            # Induced spawn-failure burst (budgeted): models the backend
+            # refusing job creation — exactly what the pool's breaker +
+            # escalation layers must absorb.
+            plan.fail_point("local_spawn")
         env = None
         if job_spec.env:
             env = dict(os.environ)

@@ -106,6 +106,11 @@ def _parse_hosts(spec: str,
 
 class TpuBackend(Backend):
     name = "tpu"
+    # Class-level defaults: shutdown_sim_cluster is atexit-registered
+    # before the health plane is constructed, so a partial __init__
+    # (sim agent failed to boot) must still shut down cleanly.
+    _prober = None
+    _detector = None
 
     def __init__(self) -> None:
         cfg = config.get()
@@ -131,6 +136,37 @@ class TpuBackend(Backend):
         self._rr = 0
         self._lock = threading.Lock()
         self._jobs: List[Job] = []
+        # Per-host health plane (fiber_tpu/health.py): the agent RPC
+        # channel doubles as its heartbeat — a prober thread pings every
+        # host each heartbeat_interval and any successful RPC beats the
+        # detector. A host silent past suspect_timeout is suspected
+        # (skipped by placement) but NOT permanent: agents restart, and
+        # a later successful ping revives the host. The breaker
+        # additionally blacklists hosts whose spawns keep FAILING even
+        # though the agent answers (bad image, full disk) — backoff +
+        # jitter, reset on the first spawn that succeeds.
+        cfg = config.get()
+        from fiber_tpu.health import (
+            CircuitBreaker, FailureDetector, Heartbeater,
+        )
+
+        self._host_breaker = CircuitBreaker(
+            fail_threshold=int(cfg.spawn_breaker_threshold),
+            base_backoff=float(cfg.spawn_breaker_backoff),
+            max_backoff=float(cfg.spawn_breaker_backoff_max),
+        )
+        self._detector = None
+        self._prober = None
+        if float(cfg.heartbeat_interval or 0) > 0 \
+                and float(cfg.suspect_timeout or 0) > 0:
+            self._detector = FailureDetector(
+                float(cfg.suspect_timeout), self._on_host_suspect,
+                permanent=False, name="fiber-agent-detector",
+            ).start()
+            self._prober = Heartbeater(
+                self._probe_hosts, float(cfg.heartbeat_interval),
+                name="fiber-agent-prober",
+            ).start()
         logger.info("tpu backend: %d host(s): %s", len(self._hosts),
                     self._hosts)
 
@@ -178,7 +214,44 @@ class TpuBackend(Backend):
             hosts.append(("127.0.0.1", port))
         return hosts
 
+    def _probe_hosts(self) -> None:
+        """One ping round (runs on the prober thread each interval). A
+        host that answers ANY rpc is alive; a failed ping is left to the
+        detector's deadline — one lost packet must not mark a host."""
+        for host in list(self._hosts):
+            try:
+                self._agent(host).call("ping")
+            except Exception:
+                continue  # silence accrues; the detector owns the call
+            detector = self._detector
+            if detector is not None:
+                detector.beat(host)
+
+    def _on_host_suspect(self, host) -> None:
+        logger.warning(
+            "health: host agent %s:%s silent past suspect_timeout; "
+            "suspending placement on it (revives on next answer)",
+            host[0], host[1])
+
+    def host_health(self) -> Dict[str, str]:
+        """Operator-facing snapshot: host -> 'ok'|'suspect'|'open'."""
+        out = {}
+        for host in self._hosts:
+            key = f"{host[0]}:{host[1]}"
+            if self._detector is not None \
+                    and self._detector.is_suspect(host):
+                out[key] = "suspect"
+            elif not self._host_breaker.allow(host):
+                out[key] = "open"
+            else:
+                out[key] = "ok"
+        return out
+
     def shutdown_sim_cluster(self) -> None:
+        if self._prober is not None:
+            self._prober.stop()
+        if self._detector is not None:
+            self._detector.stop()
         for proc in self._sim_agents:
             if proc.poll() is None:
                 proc.terminate()
@@ -231,15 +304,32 @@ class TpuBackend(Backend):
                 self._agents[host] = client
             return client
 
+    def _host_healthy(self, host: Tuple[str, int]) -> bool:
+        if self._detector is not None and self._detector.is_suspect(host):
+            return False
+        return self._host_breaker.allow(host)
+
     def _pick_host(self, spec: JobSpec) -> Tuple[str, int]:
         if spec.host_hint:
             for host in self._hosts:
                 if host[0] == spec.host_hint or \
                         f"{host[0]}:{host[1]}" == spec.host_hint:
-                    return host
+                    return host  # a pin overrides health (ring ranks
+                    # etc. are placement-significant; fail loudly there)
             raise ValueError(f"host_hint {spec.host_hint!r} not in cluster")
+        # Round-robin over HEALTHY hosts: suspected agents and
+        # open-breaker targets are skipped. With every host unhealthy,
+        # fall through to plain round-robin — a wrong placement beats a
+        # placement deadlock, and the attempt itself is the breaker's
+        # half-open trial.
         with self._lock:
-            host = self._hosts[self._rr % len(self._hosts)]
+            n = len(self._hosts)
+            for step in range(1, n + 1):
+                cand = self._hosts[(self._rr + step) % n]
+                if self._host_healthy(cand):
+                    self._rr = (self._rr + step) % n
+                    return cand
+            host = self._hosts[self._rr % n]
             self._rr += 1
         return host
 
@@ -257,10 +347,21 @@ class TpuBackend(Backend):
             limits["cpu"] = int(job_spec.cpu)
         if job_spec.mem:
             limits["mem"] = int(job_spec.mem)
-        pid, log_path = agent.call(
-            "spawn", job_spec.command, job_spec.cwd, env, job_spec.name,
-            limits,
-        )
+        try:
+            pid, log_path = agent.call(
+                "spawn", job_spec.command, job_spec.cwd, env,
+                job_spec.name, limits,
+            )
+        except Exception:
+            if self._host_breaker.record_failure(host):
+                logger.warning(
+                    "health: spawn breaker OPEN for host %s:%s after "
+                    "repeated failures; placement backs off it",
+                    host[0], host[1])
+            raise
+        self._host_breaker.record_success(host)
+        if self._detector is not None:
+            self._detector.beat(host)  # an answering agent is alive
         job = Job({"host": host, "pid": pid, "log": log_path},
                   jid=f"{host[0]}:{host[1]}/{pid}")
         job.host = host[0]

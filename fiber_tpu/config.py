@@ -37,6 +37,23 @@ DEFAULTS: Dict[str, Any] = {
     "ipc_active": True,      # worker dials master (False: master dials worker)
     "ipc_admin_master_port": 0,     # 0 = random
     "ipc_admin_worker_port": 8000,  # used only in passive mode
+    # --- health plane (docs/robustness.md) ---
+    # Worker/agent heartbeat period, seconds; 0 disables heartbeats AND
+    # the deadline failure detector (silence then only surfaces via TCP
+    # or process reaping).
+    "heartbeat_interval": 1.0,
+    # Seconds of peer silence before the failure detector declares it
+    # dead and triggers the pool's resubmit path. Must comfortably
+    # exceed heartbeat_interval (10x by default).
+    "suspect_timeout": 10.0,
+    # Consecutive spawn failures that open the per-target circuit
+    # breaker; while open, the pool stops hammering the target.
+    "spawn_breaker_threshold": 3,
+    # First open period, seconds (doubles per re-open, + jitter) and its
+    # cap. Deliberately small: the terminal _SPAWN_FAIL_LIMIT escalation
+    # in pool.py must still fire within ~a minute on a dead backend.
+    "spawn_breaker_backoff": 0.25,
+    "spawn_breaker_backoff_max": 2.0,
     # --- data plane ---
     "use_push_queue": True,
     # Strip accelerator runtime preloads from spawned host workers (faster
@@ -67,6 +84,8 @@ def _coerce(key: str, value: Any) -> Any:
             return value.strip().lower() in ("1", "true", "yes", "on")
         if isinstance(default, int) and not isinstance(default, bool):
             return int(value)
+        if isinstance(default, float):
+            return float(value)
     return value
 
 

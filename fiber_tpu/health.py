@@ -1,0 +1,255 @@
+"""Cluster health plane: heartbeats, deadline failure detection, and
+spawn circuit breaking.
+
+The process layer already survives worker death the kernel reports
+(pool.py resubmission, launcher job polling). This module adds the layer
+above it — failures the kernel does NOT report promptly: a hung host, a
+frozen process, a network path silently blackholed. Three primitives,
+mirrored from production training/inference stacks:
+
+* :class:`Heartbeater` — emits a beat on an existing channel every
+  ``heartbeat_interval`` seconds from a daemon thread. Pool workers ride
+  their result stream (the master's ``_result_loop`` already fair-merges
+  it); no extra sockets.
+* :class:`FailureDetector` — deadline-based: a peer silent for
+  ``suspect_timeout`` seconds is declared dead *before* TCP notices
+  (TCP keepalive defaults to minutes; a SIGSTOP'd peer never FINs).
+  The pool's declaration handler runs the SAME reclaim path as an
+  observed process death, so resubmission semantics cannot diverge.
+  Declaring a live-but-slow peer dead is safe by construction there:
+  resilient-pool tasks are idempotent and duplicate results dedupe.
+* :class:`CircuitBreaker` — per-key (host / backend) spawn gate with
+  exponential backoff + jitter. Replaces hammering a refusing backend
+  every maintenance tick; the terminal ``_SPAWN_FAIL_LIMIT`` escalation
+  in pool.py stays as the loud failure of last resort.
+
+Knobs live in config.py (``heartbeat_interval``, ``suspect_timeout``,
+``spawn_breaker_*``) and are documented in docs/robustness.md.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Dict, Iterable, Optional
+
+from fiber_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+
+class Heartbeater:
+    """Call ``emit()`` every ``interval`` seconds on a daemon thread.
+
+    ``emit`` does the actual send and may raise: ``TimeoutError`` skips
+    one beat (channel congested — the frames already in flight serve as
+    the beat); any ``OSError`` stops the thread (channel gone for good —
+    the process is exiting or the master died, and the watchdog layers
+    own that). ``gate`` is consulted before each beat; returning False
+    skips it (chaos uses this to simulate a hung host without touching
+    the emitter).
+    """
+
+    def __init__(self, emit: Callable[[], None], interval: float,
+                 gate: Optional[Callable[[], bool]] = None,
+                 name: str = "fiber-heartbeat") -> None:
+        if interval <= 0:
+            raise ValueError("heartbeat interval must be > 0")
+        self._emit = emit
+        self._interval = float(interval)
+        self._gate = gate
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name=name, daemon=True
+        )
+        self.beats = 0  # emitted count (observable by tests)
+
+    def start(self) -> "Heartbeater":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            if self._gate is not None and not self._gate():
+                continue
+            try:
+                self._emit()
+                self.beats += 1
+            except TimeoutError:
+                continue  # congested; data frames in flight beat for us
+            except OSError:
+                return  # channel closed under us: nothing left to beat on
+            except Exception:
+                logger.exception("heartbeater: emit failed; stopping")
+                return
+
+
+class FailureDetector:
+    """Deadline failure detector over heartbeat observations.
+
+    ``beat(peer)`` registers/refreshes a peer; a monitor thread declares
+    any peer silent for ``suspect_timeout`` seconds dead and calls
+    ``on_suspect(peer)`` (outside the detector lock — handlers may call
+    back into :meth:`forget`). With ``permanent=True`` (pool worker
+    idents, which are never reused) a declared peer stays dead and its
+    late beats are ignored; with ``permanent=False`` (host agents, which
+    restart) a later beat revives the peer and ``on_suspect`` may fire
+    again on the next silence.
+    """
+
+    def __init__(self, suspect_timeout: float,
+                 on_suspect: Callable[[object], None],
+                 permanent: bool = True,
+                 name: str = "fiber-failure-detector") -> None:
+        if suspect_timeout <= 0:
+            raise ValueError("suspect_timeout must be > 0")
+        self._timeout = float(suspect_timeout)
+        self._on_suspect = on_suspect
+        self._permanent = permanent
+        self._last_seen: Dict[object, float] = {}
+        self._dead: set = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name=name, daemon=True
+        )
+        self.suspected_total = 0  # lifetime declarations (observable)
+
+    def start(self) -> "FailureDetector":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def beat(self, peer) -> None:
+        now = time.monotonic()
+        revived = False
+        with self._lock:
+            if peer in self._dead:
+                if self._permanent:
+                    return  # declared dead stays dead; ident won't reuse
+                self._dead.discard(peer)
+                revived = True
+            self._last_seen[peer] = now
+        if revived:
+            logger.info("health: peer %r revived after being declared "
+                        "dead", peer)
+
+    def forget(self, peer) -> None:
+        """Deregister a peer whose death was observed through another
+        path (process reap, clean retirement) so it is never suspected
+        post-mortem."""
+        with self._lock:
+            self._last_seen.pop(peer, None)
+            if self._permanent:
+                self._dead.add(peer)
+
+    def is_suspect(self, peer) -> bool:
+        with self._lock:
+            return peer in self._dead
+
+    def peers(self) -> Iterable:
+        with self._lock:
+            return list(self._last_seen)
+
+    def _loop(self) -> None:
+        tick = min(max(self._timeout / 4.0, 0.05), 1.0)
+        while not self._stop.wait(tick):
+            deadline = time.monotonic() - self._timeout
+            with self._lock:
+                expired = [p for p, seen in self._last_seen.items()
+                           if seen < deadline]
+                for peer in expired:
+                    del self._last_seen[peer]
+                    self._dead.add(peer)
+                    self.suspected_total += 1
+            for peer in expired:
+                try:
+                    self._on_suspect(peer)
+                except Exception:
+                    logger.exception("health: on_suspect handler failed "
+                                     "for %r", peer)
+
+
+class CircuitBreaker:
+    """Per-key spawn-target breaker with exponential backoff + jitter.
+
+    closed → (``fail_threshold`` consecutive failures) → open for
+    ``base_backoff * 2^(opens-1)`` seconds (capped at ``max_backoff``,
+    stretched by up to ``jitter`` fraction so a fleet of masters never
+    retries a recovering host in lockstep) → half-open: the next
+    ``allow()`` admits one trial; its failure reopens with doubled
+    backoff, its success closes and resets everything.
+    """
+
+    def __init__(self, fail_threshold: int = 3,
+                 base_backoff: float = 0.25,
+                 max_backoff: float = 2.0,
+                 jitter: float = 0.25,
+                 rng: Optional[random.Random] = None) -> None:
+        if fail_threshold < 1:
+            raise ValueError("fail_threshold must be >= 1")
+        self._threshold = int(fail_threshold)
+        self._base = float(base_backoff)
+        self._max = float(max_backoff)
+        self._jitter = float(jitter)
+        self._rng = rng or random.Random()
+        self._lock = threading.Lock()
+        # key -> [consecutive_fails, opens, open_until (monotonic) | None]
+        self._state: Dict[object, list] = {}
+        self.opened_total = 0  # lifetime opens across keys (observable)
+
+    def _entry(self, key) -> list:
+        entry = self._state.get(key)
+        if entry is None:
+            entry = self._state[key] = [0, 0, None]
+        return entry
+
+    def allow(self, key) -> bool:
+        """True unless the key's breaker is open (an expired open period
+        admits trial attempts — half-open)."""
+        with self._lock:
+            entry = self._state.get(key)
+            if entry is None or entry[2] is None:
+                return True
+            return time.monotonic() >= entry[2]
+
+    def record_failure(self, key) -> bool:
+        """Count one failure; returns True when this failure opened (or
+        re-opened) the breaker."""
+        with self._lock:
+            entry = self._entry(key)
+            entry[0] += 1
+            half_open = entry[2] is not None \
+                and time.monotonic() >= entry[2]
+            if entry[0] < self._threshold and not half_open:
+                return False
+            entry[1] += 1
+            self.opened_total += 1
+            backoff = min(self._base * (2 ** (entry[1] - 1)), self._max)
+            backoff *= 1.0 + self._jitter * self._rng.random()
+            entry[2] = time.monotonic() + backoff
+            entry[0] = 0  # streak restarts toward the next open
+            return True
+
+    def record_success(self, key) -> None:
+        with self._lock:
+            self._state.pop(key, None)
+
+    def state(self, key) -> str:
+        with self._lock:
+            entry = self._state.get(key)
+            if entry is None or entry[2] is None:
+                return "closed"
+            return "half-open" if time.monotonic() >= entry[2] else "open"
+
+    def open_keys(self) -> Iterable:
+        now = time.monotonic()
+        with self._lock:
+            return [k for k, e in self._state.items()
+                    if e[2] is not None and now < e[2]]

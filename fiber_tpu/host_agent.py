@@ -206,6 +206,15 @@ class HostAgent:
 
     def _op_spawn(self, command, cwd, env, name,
                   limits: Optional[dict] = None) -> Tuple[int, str]:
+        from fiber_tpu.testing import chaos
+
+        plan = chaos._plan
+        if plan is not None:
+            # Induced agent-side spawn refusal (budgeted): surfaces to
+            # the master as an RPC error from this host — the per-host
+            # breaker/blacklist case, distinct from a local_spawn
+            # failure which hits every target equally.
+            plan.fail_point("agent_spawn")
         limits = limits or {}
         cpu = limits.get("cpu")
         mem = limits.get("mem")

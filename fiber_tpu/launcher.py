@@ -32,6 +32,7 @@ from fiber_tpu.backends import get_backend
 from fiber_tpu.core import Job, JobSpec, ProcessStatus
 from fiber_tpu.framing import send_frame
 from fiber_tpu.meta import get_meta
+from fiber_tpu.testing import chaos
 from fiber_tpu.utils.logging import get_logger
 
 logger = get_logger()
@@ -103,6 +104,9 @@ class JobLauncher:
         # bearer capability for the master's pickled process state.
         spec.env["FIBER_LAUNCH_IDENT"] = str(ident)
         try:
+            plan = chaos._plan
+            if plan is not None:
+                plan.fail_point("launch")
             self.job = self.backend.create_job(spec)
         except Exception:
             if admin is not None:
@@ -270,15 +274,41 @@ class JobLauncher:
                 )
             time.sleep(0.2)
 
+    #: Synthetic exit code for a job whose backend became unreachable
+    #: (host agent died, cluster torn down): its real status is
+    #: unknowable, and the health-plane posture is that a dead agent's
+    #: jobs are dead.
+    LOST_RETURNCODE = -255
+
     # ------------------------------------------------------------------
     def poll(self) -> Optional[int]:
         if self.returncode is None:
-            self.returncode = self.backend.wait_for_job(self.job, 0)
+            try:
+                self.returncode = self.backend.wait_for_job(self.job, 0)
+            except Exception as err:
+                # Backend unreachable: declare the job lost instead of
+                # propagating into every is_alive()/active_children()
+                # caller (pre-fix, one dead sim agent turned every later
+                # liveness check in the process into a raised
+                # ConnectionRefusedError).
+                logger.warning(
+                    "poll: backend unreachable for job %s (%s); "
+                    "declaring it lost", getattr(self.job, "jid", "?"),
+                    err)
+                self.returncode = self.LOST_RETURNCODE
         return self.returncode
 
     def wait(self, timeout: Optional[float] = None) -> Optional[int]:
         if self.returncode is None:
-            self.returncode = self.backend.wait_for_job(self.job, timeout)
+            try:
+                self.returncode = self.backend.wait_for_job(
+                    self.job, timeout)
+            except Exception as err:
+                logger.warning(
+                    "wait: backend unreachable for job %s (%s); "
+                    "declaring it lost", getattr(self.job, "jid", "?"),
+                    err)
+                self.returncode = self.LOST_RETURNCODE
         return self.returncode
 
     def terminate(self) -> None:

@@ -32,7 +32,7 @@ def psum_sharded(x, mesh=None, axis: str = "pool"):
     replicated total. Lowers to one XLA all-reduce over ICI."""
     import jax
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from fiber_tpu.utils.jaxcompat import shard_map
 
     from fiber_tpu.parallel.mesh import default_mesh
 

@@ -52,7 +52,7 @@ def build_fused_runner(device_step, mesh, n_state: int,
     ``stats_seq.shape[0] == generations``.
     """
     import jax
-    from jax import shard_map
+    from fiber_tpu.utils.jaxcompat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def device_run(*args):
@@ -192,7 +192,7 @@ class EvolutionStrategy(_FusedRunMixin):
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        from fiber_tpu.utils.jaxcompat import shard_map
 
         eval_fn = self.eval_fn
         sigma = self.sigma

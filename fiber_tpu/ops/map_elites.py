@@ -128,7 +128,7 @@ class MAPElites:
     def _build_step(self):
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from fiber_tpu.utils.jaxcompat import shard_map
         from jax.sharding import PartitionSpec as P
 
         eval_fn = self.eval_fn

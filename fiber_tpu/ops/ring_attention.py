@@ -336,7 +336,7 @@ def _build_ring_attention(mesh, axis: str, causal: bool,
     import functools
 
     import jax
-    from jax import shard_map
+    from fiber_tpu.utils.jaxcompat import shard_map
     from jax.sharding import PartitionSpec as P
 
     body = functools.partial(

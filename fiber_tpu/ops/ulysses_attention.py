@@ -94,7 +94,7 @@ def _build(mesh, axis: str, causal: bool, local: str):
     import functools
 
     import jax
-    from jax import shard_map
+    from fiber_tpu.utils.jaxcompat import shard_map
     from jax.sharding import PartitionSpec as P
 
     local_fn = functools.partial(

@@ -54,7 +54,7 @@ def _compiled_mapper(fn: Callable, mesh, multi_arg: bool,
     (fn, mesh, donate)."""
     import jax
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from fiber_tpu.utils.jaxcompat import shard_map
 
     try:
         hash(fn)

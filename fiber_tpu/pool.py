@@ -38,6 +38,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from fiber_tpu import serialization
 from fiber_tpu.meta import get_meta
+from fiber_tpu.testing import chaos
 from fiber_tpu.transport import Endpoint, TransportClosed
 from fiber_tpu.utils.logging import get_logger
 
@@ -240,6 +241,21 @@ class ResultStore:
     def outstanding(self) -> int:
         with self._cond:
             return sum(e.remaining for e in self._entries.values())
+
+    def wait_outstanding_below(self, limit: int,
+                               timeout: Optional[float] = None) -> bool:
+        """Block until the in-flight item count is <= ``limit`` (True)
+        or ``timeout`` elapses (False). Rides the store's condition —
+        every fill/fail notifies it — so backpressure waits cost no
+        idle CPU. Only downward transitions matter to the predicate, so
+        submissions (which raise the count without notifying) can't
+        strand a waiter on a stale True."""
+        with self._cond:
+            return self._cond.wait_for(
+                lambda: sum(e.remaining
+                            for e in self._entries.values()) <= limit,
+                timeout,
+            )
 
     def is_done(self, seq: int) -> bool:
         """True when ``seq`` has completed or failed — its chunks are
@@ -511,7 +527,12 @@ def pool_worker(
                 # retried) rather than freeze the monitor loop — this is
                 # the parent's only thread. Reports are rare and tiny,
                 # so the native fast path buys nothing here.
-                ep = connect_transport("w", ctl_addr, native=False)
+                # retries=0: the transport's connect backoff would turn
+                # "master unreachable" into ~1s of doomed redials per
+                # attempt on this single-threaded monitor; the 1s tick
+                # gate is the retry policy here.
+                ep = connect_transport("w", ctl_addr, native=False,
+                                       retries=0)
                 try:
                     ep.send(serialization.dumps((kind, ident)),
                             timeout=10.0)
@@ -641,7 +662,27 @@ def _pool_worker_core(
     completed_chunks = 0
     reason = "error"
     next_task = None
+    heartbeater = None
     if resilient:
+        # Health plane: beat on the result stream (the master's result
+        # loop already fair-merges it; no extra sockets) so the failure
+        # detector can declare this worker dead on silence — a hung
+        # host stops beating long before TCP notices. Plain pools skip
+        # it: with no pending table there is nothing a declaration
+        # could resubmit.
+        from fiber_tpu import config as fconfig
+        from fiber_tpu.health import Heartbeater
+
+        hb_interval = float(fconfig.get().heartbeat_interval or 0)
+        if hb_interval > 0:
+            hb_payload = serialization.dumps(("hb", ident))
+
+            def _emit_beat() -> None:
+                result_ep.send(hb_payload, timeout=hb_interval)
+
+            heartbeater = Heartbeater(
+                _emit_beat, hb_interval, gate=chaos.heartbeats_allowed,
+            ).start()
         # Pipelined REQ/REP handout: a fetch thread keeps exactly one
         # chunk staged locally so the ready->task round trip overlaps
         # compute instead of serializing with it (the reference's REQ
@@ -696,18 +737,29 @@ def _pool_worker_core(
                 reason = "exit"
                 break
             _, seq, base, digest, blob, chunk, star = msg
+            plan = chaos._plan
+            if plan is not None:
+                # Hang BEFORE compute (the held chunk is what the
+                # detector must get resubmitted); kill AFTER a result
+                # (so the death strands staged/queued chunks, the
+                # resubmission case worth inducing).
+                plan.maybe_hang_worker(completed_chunks)
             fn = funcs.get(digest, blob)
             values = _run_chunk(fn, chunk, star)
             result_ep.send(
                 serialization.dumps(("result", seq, base, values, ident))
             )
             completed_chunks += 1
+            if plan is not None:
+                plan.maybe_kill_worker(completed_chunks)
             if maxtasksperchild and completed_chunks >= maxtasksperchild:
                 reason = "recycle"
                 break
     except (TransportClosed, OSError):
         pass  # master went away; the watchdog handles hard exits
     finally:
+        if heartbeater is not None:
+            heartbeater.stop()
         task_ep.close()
         result_ep.close()
     return reason
@@ -745,6 +797,21 @@ class Pool:
         # Workers are packed cpu_per_job sub-workers per job, the last job
         # taking the remainder (reference: fiber/pool.py:1009-1057).
         self._cpu_per_job = max(1, int(cfg.cpu_per_job))
+        from fiber_tpu.health import CircuitBreaker
+
+        #: Health plane (fiber_tpu/health.py). The detector is armed by
+        #: ResilientPool only — a plain pool has no pending table, so a
+        #: death declaration would have nothing to resubmit. The spawn
+        #: breaker gates _maintain_workers: a refusing backend is
+        #: retried on exponential backoff instead of every 0.2s tick
+        #: (the terminal _SPAWN_FAIL_LIMIT escalation below remains).
+        self._detector = None
+        self._spawn_key = "spawn"
+        self._spawn_breaker = CircuitBreaker(
+            fail_threshold=int(cfg.spawn_breaker_threshold),
+            base_backoff=float(cfg.spawn_breaker_backoff),
+            max_backoff=float(cfg.spawn_breaker_backoff_max),
+        )
 
         ip, _, _ = get_backend().get_listen_addr()
         self._task_ep = Endpoint("rep" if self._resilient else "w")
@@ -825,6 +892,7 @@ class Pool:
             with self._workers_lock:
                 self._spawn_fail_streak = 0
                 self._last_spawn_error = None
+            self._spawn_breaker.record_success(self._spawn_key)
             return p
         except Exception as exc:
             logger.warning("pool worker start failed; will retry",
@@ -832,6 +900,10 @@ class Pool:
             with self._workers_lock:
                 self._spawn_fail_streak += 1
                 self._last_spawn_error = f"{type(exc).__name__}: {exc}"
+            if self._spawn_breaker.record_failure(self._spawn_key):
+                logger.warning(
+                    "pool: spawn breaker OPEN for %r after repeated "
+                    "start failures; backing off", self._spawn_key)
             return None
 
     def _worker_loop(self) -> None:
@@ -866,6 +938,13 @@ class Pool:
         # Respawning continues through a close() drain (resubmitted chunks
         # need somewhere to run) and stops only once drained.
         if self._terminated or self._draining_done():
+            return
+        # Breaker open: the target refused spawns repeatedly — skip this
+        # tick instead of hammering it; the open period (exponential
+        # backoff + jitter) is the retry schedule. The escalation check
+        # below already ran in the tick that opened the breaker, so a
+        # ripe streak can never be stranded behind an open breaker.
+        if not self._spawn_breaker.allow(self._spawn_key):
             return
         plan = []
         while missing_subs > 0:
@@ -947,10 +1026,13 @@ class Pool:
             if item is None:
                 return
             payload, _key = item
-            while self._store.outstanding() > MAX_INFLIGHT_TASKS:
+            # Backpressure waits on the store's condition (woken by
+            # every completion) instead of a 10ms poll; the timeout
+            # only bounds how long a terminate() can go unnoticed.
+            while not self._store.wait_outstanding_below(
+                    MAX_INFLIGHT_TASKS, timeout=0.5):
                 if self._terminated:
                     return
-                time.sleep(0.01)
             while True:
                 if self._terminated:
                     return
@@ -972,9 +1054,20 @@ class Pool:
             # hangs every outstanding .get() (advisor, round 1).
             try:
                 msg = serialization.loads(data)
+                detector = self._detector
+                if msg[0] == "hb":
+                    if detector is not None:
+                        detector.beat(msg[1])
+                    continue
                 if msg[0] != "result":
                     continue
                 _, seq, base, values, ident = msg
+                if detector is not None:
+                    # Results prove liveness as well as any beat: a
+                    # worker mid-long-GIL-hold may miss beats while
+                    # still making progress, and progress must never
+                    # read as death.
+                    detector.beat(ident)
                 self._on_result(seq, base, values, ident)
                 self._store.fill(seq, base, values)
             except Exception:
@@ -1321,6 +1414,26 @@ class ResilientPool(Pool):
         self._dead_idents_order: "deque[bytes]" = deque(maxlen=4096)
         self._pending_lock = threading.Lock()
         super().__init__(*args, **kwargs)
+        # Health plane: workers beat on the result stream; silence past
+        # suspect_timeout declares the ident dead and reclaims its
+        # pending chunks through the SAME path as an observed process
+        # death — so a hung host (no FIN, no exit code) is survived
+        # before TCP would notice. Declared idents are permanent: pool
+        # idents are never reused, and a falsely-declared (merely slow)
+        # worker is told to exit on its next "ready", its duplicate
+        # results deduped by ResultStore.fill. Workers can't connect
+        # before this point (they spawn lazily at first submit), so no
+        # beat can precede the detector.
+        from fiber_tpu import config as _config
+        from fiber_tpu.health import FailureDetector
+
+        _cfg = _config.get()
+        if float(_cfg.heartbeat_interval or 0) > 0 \
+                and float(_cfg.suspect_timeout or 0) > 0:
+            self._detector = FailureDetector(
+                float(_cfg.suspect_timeout), self._on_peer_suspect,
+                permanent=True, name="fiber-pool-detector",
+            ).start()
         # Dedicated control endpoint for packing-parent sub-worker
         # reports. Deliberately NOT the result endpoint (its peer count
         # is what wait_workers() reads as "workers connected") and NOT
@@ -1358,8 +1471,32 @@ class ResilientPool(Pool):
 
     def _shutdown_transport(self) -> None:
         super()._shutdown_transport()
+        if self._detector is not None:
+            self._detector.stop()
         if self._ctl_ep is not None:
             self._ctl_ep.close()
+
+    def _on_peer_suspect(self, ident: bytes) -> None:
+        """Failure-detector declaration: treat the silent ident exactly
+        like a reported death (resubmit its pending chunks, block
+        future handouts to it). Runs on the detector thread."""
+        n = self._reclaim_ident(ident)
+        if n:
+            logger.warning(
+                "health: worker ident %s silent past suspect_timeout; "
+                "declared dead, resubmitted %d pending chunks",
+                ident.hex()[:8], n)
+            # Resubmitted chunks can clear parked requests' gates.
+            if self._parked_count:
+                try:
+                    self._task_ep.wake()
+                except (TransportClosed, OSError):
+                    pass
+        else:
+            logger.info(
+                "health: idle worker ident %s silent past "
+                "suspect_timeout; declared dead (nothing to resubmit)",
+                ident.hex()[:8])
 
     def _mark_ident_dead(self, ident: bytes) -> None:
         # Caller holds _pending_lock.
@@ -1552,6 +1689,11 @@ class ResilientPool(Pool):
         number of chunks resubmitted. Duplicate executions this can cause
         are safe: resilient-pool tasks must be idempotent and duplicate
         results are deduped by ResultStore.fill."""
+        if self._detector is not None:
+            # Death observed (or declared): the detector must never
+            # post-mortem-suspect this ident, and late beats from a
+            # not-actually-dead declaree must not resurrect it.
+            self._detector.forget(ident)
         with self._pending_lock:
             self._mark_ident_dead(ident)
             table = self._pending.pop(ident, {})

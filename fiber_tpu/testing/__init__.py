@@ -1,0 +1,5 @@
+"""Test-facing utilities shipped with the package (not just the test
+suite): the deterministic fault-injection harness lives here so users can
+chaos-test their own pool workloads, and so the injection hooks compiled
+into pool/transport/launcher code resolve in every process of the tree
+(workers import the same module the master does)."""

@@ -1,0 +1,273 @@
+"""Deterministic, seed-driven fault injection (the chaos harness).
+
+Every robustness claim above the process layer — transport reconnects,
+failure-detector declarations, spawn-target breaking — is untestable
+folklore without a way to *induce* the faults reproducibly. This module
+is that way: a :class:`ChaosPlan` describes a fault schedule; hook sites
+compiled into pool.py, transport/tcp.py, launcher.py, host_agent.py and
+backends/local.py consult the active plan (a single ``is None`` check
+when chaos is off, so the hot paths pay nothing).
+
+Activation:
+
+* programmatic (tests): ``chaos.install(ChaosPlan(seed=7, ...))`` /
+  ``chaos.uninstall()`` — install also exports the plan to the
+  ``FIBER_CHAOS`` environment variable so every child process of the
+  tree (pool workers, sim agents) reconstructs the SAME plan at import;
+* environment: ``FIBER_CHAOS="seed=7,kill_after_chunks=3,..."`` set
+  before the master starts.
+
+Determinism: the plan itself is a pure function of its spec string, and
+cluster-wide budgets ("kill at most N workers total") are token files
+under ``token_dir`` acquired with ``O_EXCL`` — any process of the tree
+can claim a token, exactly ``limit`` ever succeed, and a fresh
+``token_dir`` (the test fixture uses tmp_path) resets the schedule.
+Which worker draws a given token is scheduling-dependent; the *assertion
+level* (map completes, with correct results, having survived the
+scheduled faults) is deterministic, which is what the seeds pin in CI.
+
+Injection points (all no-ops unless the matching knob is set):
+
+====================  ====================================================
+kill_after_chunks     pool worker ``os._exit``\\ s after completing its
+                      N-th chunk (budget ``kill_times``) — induced
+                      worker death mid-map
+hang_after_chunks     pool worker freezes (compute stalls AND heartbeats
+                      stop) for ``hang_s`` seconds when about to run its
+                      N-th chunk (budget ``hang_times``) — a hung host
+fail_local_spawn      LocalBackend.create_job raises (budget) — spawn
+                      failure burst at the backend boundary
+fail_launch           JobLauncher raises before create_job (budget)
+fail_agent_spawn      host agent's spawn op raises (budget)
+stall_recv_after      one bound-``r`` ingress channel's reader sleeps
+                      ``stall_recv_s`` seconds after its N-th data frame
+                      (budget ``stall_recv_times``) — a silent network
+                      stall the failure detector must beat TCP to
+drop_recv_every       bound-``r`` ingress drops every N-th data frame —
+                      lossy-path transport testing (NOTE: dropped result
+                      frames are only recovered through worker death or
+                      detector declaration; don't combine with
+                      completion assertions unless one of those fires)
+send_delay_every/_s   every N-th Endpoint.send sleeps first — latency
+                      injection on the master's egress
+====================  ====================================================
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from typing import Optional
+
+ENV_VAR = "FIBER_CHAOS"
+
+#: Chaos-killed workers exit with this code (distinct from user exits and
+#: the subworker recycle/transport codes in pool.py).
+CHAOS_EXIT_CODE = 44
+
+#: Budget-bearing fail points (``fail_<site>`` knobs / token kinds).
+FAIL_SITES = ("local_spawn", "launch", "agent_spawn")
+
+_INT_FIELDS = (
+    "seed", "kill_after_chunks", "kill_times",
+    "hang_after_chunks", "hang_times",
+    "fail_local_spawn", "fail_launch", "fail_agent_spawn",
+    "stall_recv_after", "stall_recv_times",
+    "drop_recv_every", "send_delay_every",
+)
+_FLOAT_FIELDS = ("hang_s", "stall_recv_s", "send_delay_s")
+
+
+class ChaosError(RuntimeError):
+    """An injected failure. Deliberately a plain RuntimeError subclass:
+    the code under test must treat it exactly like the real fault it
+    models (a refused spawn, a dead agent), never special-case it."""
+
+
+class ChaosPlan:
+    """One immutable fault schedule (see module docstring for knobs)."""
+
+    def __init__(self, seed: int = 0, token_dir: Optional[str] = None,
+                 kill_after_chunks: int = 0, kill_times: int = 1,
+                 hang_after_chunks: int = 0, hang_s: float = 3.0,
+                 hang_times: int = 1,
+                 fail_local_spawn: int = 0, fail_launch: int = 0,
+                 fail_agent_spawn: int = 0,
+                 stall_recv_after: int = 0, stall_recv_s: float = 0.0,
+                 stall_recv_times: int = 1,
+                 drop_recv_every: int = 0,
+                 send_delay_every: int = 0,
+                 send_delay_s: float = 0.0) -> None:
+        self.seed = int(seed)
+        self.token_dir = token_dir or os.path.join(
+            tempfile.gettempdir(), f"fiber-chaos-{self.seed}")
+        self.kill_after_chunks = int(kill_after_chunks)
+        self.kill_times = int(kill_times)
+        self.hang_after_chunks = int(hang_after_chunks)
+        self.hang_s = float(hang_s)
+        self.hang_times = int(hang_times)
+        self.fail_local_spawn = int(fail_local_spawn)
+        self.fail_launch = int(fail_launch)
+        self.fail_agent_spawn = int(fail_agent_spawn)
+        self.stall_recv_after = int(stall_recv_after)
+        self.stall_recv_s = float(stall_recv_s)
+        self.stall_recv_times = int(stall_recv_times)
+        self.drop_recv_every = int(drop_recv_every)
+        self.send_delay_every = int(send_delay_every)
+        self.send_delay_s = float(send_delay_s)
+        # Process-local state.
+        self._lock = threading.Lock()
+        self._hang_until = 0.0
+        self._send_count = 0
+
+    # -- spec (env) form ------------------------------------------------
+    @classmethod
+    def from_env(cls, spec: Optional[str]) -> Optional["ChaosPlan"]:
+        """Parse ``k=v,k=v,...``; None/empty → no plan. Unknown keys
+        raise (a typo'd knob silently injecting nothing would make a
+        chaos run vacuously green)."""
+        if not spec:
+            return None
+        kwargs = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, _, raw = part.partition("=")
+            key = key.strip()
+            raw = raw.strip()
+            if key in _INT_FIELDS:
+                kwargs[key] = int(raw)
+            elif key in _FLOAT_FIELDS:
+                kwargs[key] = float(raw)
+            elif key == "token_dir":
+                kwargs[key] = raw
+            else:
+                raise ValueError(f"unknown chaos knob {key!r} in "
+                                 f"{ENV_VAR}")
+        return cls(**kwargs)
+
+    def to_env(self) -> str:
+        parts = [f"seed={self.seed}", f"token_dir={self.token_dir}"]
+        for field in _INT_FIELDS + _FLOAT_FIELDS:
+            if field == "seed":
+                continue
+            parts.append(f"{field}={getattr(self, field)}")
+        return ",".join(parts)
+
+    # -- cluster-wide budgets -------------------------------------------
+    def acquire(self, kind: str, limit: int) -> bool:
+        """Claim one token of ``kind``; at most ``limit`` claims succeed
+        across ALL processes sharing this plan's token_dir (O_EXCL file
+        creation is the atomic arbiter)."""
+        if limit <= 0:
+            return False
+        try:
+            os.makedirs(self.token_dir, exist_ok=True)
+        except OSError:
+            return False
+        for i in range(limit):
+            path = os.path.join(self.token_dir, f"{kind}-{i}")
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                continue
+            except OSError:
+                return False
+            os.close(fd)
+            return True
+        return False
+
+    def spent(self, kind: str) -> int:
+        """How many ``kind`` tokens have been claimed so far."""
+        try:
+            names = os.listdir(self.token_dir)
+        except OSError:
+            return 0
+        return sum(1 for n in names if n.startswith(kind + "-"))
+
+    # -- injection points ------------------------------------------------
+    def maybe_kill_worker(self, completed_chunks: int) -> None:
+        """pool worker, after completing a chunk: die hard mid-map."""
+        if (self.kill_after_chunks
+                and completed_chunks == self.kill_after_chunks
+                and self.acquire("kill", self.kill_times)):
+            os._exit(CHAOS_EXIT_CODE)
+
+    def maybe_hang_worker(self, completed_chunks: int) -> None:
+        """pool worker, before running a chunk: freeze compute AND
+        heartbeats — a hung host, as seen from the master."""
+        if (self.hang_after_chunks
+                and completed_chunks == self.hang_after_chunks
+                and self.acquire("hang", self.hang_times)):
+            with self._lock:
+                self._hang_until = time.monotonic() + self.hang_s
+            time.sleep(self.hang_s)
+
+    def heartbeats_allowed(self) -> bool:
+        with self._lock:
+            return time.monotonic() >= self._hang_until
+
+    def fail_point(self, site: str) -> None:
+        """Raise ChaosError at a named spawn-path site while its budget
+        lasts (``fail_<site>`` knob)."""
+        budget = getattr(self, f"fail_{site}")
+        if budget and self.acquire(f"fail-{site}", budget):
+            raise ChaosError(f"chaos: injected {site} failure "
+                             f"(seed={self.seed})")
+
+    def on_recv_frame(self, chan) -> bool:
+        """Bound-``r`` ingress reader, per data frame. Returns False to
+        drop the frame; may sleep first (stall injection). Counters ride
+        the channel object so each connection has its own schedule."""
+        count = getattr(chan, "_chaos_rx", 0) + 1
+        chan._chaos_rx = count
+        if (self.stall_recv_after and count == self.stall_recv_after
+                and self.acquire("stall", self.stall_recv_times)):
+            time.sleep(self.stall_recv_s)
+        if self.drop_recv_every and count % self.drop_recv_every == 0:
+            return False
+        return True
+
+    def on_send_frame(self) -> None:
+        """Endpoint.send, per frame: latency injection."""
+        if not self.send_delay_every:
+            return
+        with self._lock:
+            self._send_count += 1
+            delay = self._send_count % self.send_delay_every == 0
+        if delay:
+            time.sleep(self.send_delay_s)
+
+
+#: The active plan. Hook sites read this attribute directly — None means
+#: chaos is off and costs one attribute load.
+_plan: Optional[ChaosPlan] = ChaosPlan.from_env(os.environ.get(ENV_VAR))
+
+
+def active() -> Optional[ChaosPlan]:
+    return _plan
+
+
+def install(plan: ChaosPlan) -> ChaosPlan:
+    """Activate ``plan`` in this process AND export it so child
+    processes (pool workers, sim agents) reconstruct it at import."""
+    global _plan
+    _plan = plan
+    os.environ[ENV_VAR] = plan.to_env()
+    return plan
+
+
+def uninstall() -> None:
+    global _plan
+    _plan = None
+    os.environ.pop(ENV_VAR, None)
+
+
+def heartbeats_allowed() -> bool:
+    """Gate for Heartbeater: False while the active plan simulates a
+    hung host in this process."""
+    plan = _plan
+    return plan is None or plan.heartbeats_allowed()

@@ -23,9 +23,11 @@ import itertools
 import socket as pysocket
 import struct
 import threading
-from typing import Dict, List, Optional, Tuple
+import time
+from typing import List, Optional, Tuple
 
 from fiber_tpu import auth
+from fiber_tpu.testing import chaos
 from fiber_tpu.framing import (
     ConnectionClosed,
     recv_frame,
@@ -112,6 +114,7 @@ class _Channel:
         self.alive = True
         self.credit = 0  # how many frames the peer is ready to accept
         self.replenish_owed = 0  # batched standing-window replenish
+        self.last_rx: Optional[float] = None  # monotonic, any frame kind
         self._send_lock = threading.Lock()
         sock.setsockopt(pysocket.IPPROTO_TCP, pysocket.TCP_NODELAY, 1)
         self._reader: Optional[threading.Thread] = None
@@ -128,6 +131,10 @@ class _Channel:
         try:
             while True:
                 frame = recv_frame(self.sock)
+                # Observable silence: the failure detector reads last_rx
+                # instead of opening extra sockets; credit frames count
+                # too (any byte proves the peer's stack is alive).
+                self.last_rx = self.owner.last_rx = time.monotonic()
                 kind = frame[:1]
                 if kind == _T_CREDIT:
                     (n,) = _CREDIT.unpack(frame[1:5])
@@ -135,6 +142,23 @@ class _Channel:
                         self.credit += n
                         self.owner._chan_lock.notify_all()
                 else:
+                    # Chaos injection point (no-op unless a plan is
+                    # active): bound-r ingress only — REQ/REP and
+                    # connected endpoints have lockstep protocols a
+                    # dropped/stalled frame would wedge rather than
+                    # degrade, which is not the fault being modeled.
+                    plan = chaos._plan
+                    if (plan is not None and self.owner._is_bound
+                            and self.owner.mode == "r"
+                            and not plan.on_recv_frame(self)):
+                        # Dropped: model LOSS, not throttling — hand the
+                        # consumed window slot back so the sender's
+                        # standing credit doesn't shrink per drop.
+                        try:
+                            self.send_credit(1)
+                        except OSError:
+                            pass
+                        continue
                     # Arrival consumes the credit that pulled it: count
                     # each undelivered frame ONCE (inbox qsize), so the
                     # prefetch window arithmetic in _maybe_grant doesn't
@@ -209,6 +233,11 @@ class Endpoint:
         self._waiting_readers = 0
         self._recv_lock = threading.Lock()
         self._wake_queued = False  # coalesces Endpoint.wake nudges
+        #: Monotonic timestamp of the newest frame received on ANY of
+        #: this endpoint's channels (None until the first). The failure
+        #: detector observes silence through this instead of extra
+        #: sockets; per-connection granularity lives on _Channel.last_rx.
+        self.last_rx: Optional[float] = None
 
     # -- wiring -----------------------------------------------------------
     def bind(self, ip: str, port: int = 0) -> str:
@@ -242,9 +271,28 @@ class Endpoint:
         self._accept_thread.start()
         return self.addr
 
-    def connect(self, addr: str) -> "Endpoint":
+    def connect(self, addr: str, retries: int = 3,
+                retry_base: float = 0.1) -> "Endpoint":
+        """Dial ``addr`` with bounded exponential-backoff retry on
+        connection errors (``retries`` extra attempts, delays
+        ``retry_base * 2^k`` capped at 2 s). Retry covers exactly the
+        window a restarting listener or a momentarily full accept
+        backlog creates; an *authentication* failure is terminal — the
+        key won't get righter by redialing. ``retries=0`` restores the
+        old single-shot behavior (watchdog-style callers that must fail
+        fast when the master is gone)."""
         host, port = parse_addr(addr)
-        sock = pysocket.create_connection((host, port), timeout=30.0)
+        attempt = 0
+        while True:
+            try:
+                sock = pysocket.create_connection((host, port),
+                                                  timeout=30.0)
+                break
+            except OSError:
+                if attempt >= retries:
+                    raise
+                time.sleep(min(retry_base * (2 ** attempt), 2.0))
+                attempt += 1
         sock.settimeout(None)
         if auth.auth_enabled():
             try:
@@ -337,6 +385,9 @@ class Endpoint:
 
     # -- data path --------------------------------------------------------
     def send(self, payload: bytes, timeout: Optional[float] = None) -> None:
+        plan = chaos._plan
+        if plan is not None:
+            plan.on_send_frame()  # latency injection (no-op by default)
         if self.mode == "r":
             raise TransportClosed("receive-only endpoint")
         if self.mode == "rep":
@@ -590,7 +641,7 @@ _NATIVE_MODE_MAP = {"r": "r", "w": "w", "rw": "rw", "req": "rw"}
 
 
 def connect_transport(mode: str, addr: str, native: bool = True,
-                      prefetch: int = 1):
+                      prefetch: int = 1, retries: int = 3):
     """The one place that picks a connection-side transport: the native C
     client (framing + socket + credit protocol per ctypes call) when the
     library loads and the address is a numeric IPv4, else a Python
@@ -600,7 +651,10 @@ def connect_transport(mode: str, addr: str, native: bool = True,
     ``native=False`` forces the Python Endpoint — for callers that need
     honored send deadlines (the C client's send blocks on the credit
     wait with no timeout plumbing; fine for the data path, wrong for
-    watchdog-style control sends that must never freeze)."""
+    watchdog-style control sends that must never freeze). ``retries``
+    bounds the Python path's connect backoff retry; pass 0 for callers
+    that must fail fast when the peer is gone (the native client keeps
+    its own single-shot connect)."""
     host, port = parse_addr(addr)
     native_mode = _NATIVE_MODE_MAP.get(mode) if native else None
     if native_mode is not None and host.count(".") == 3 and \
@@ -613,7 +667,7 @@ def connect_transport(mode: str, addr: str, native: bool = True,
                                     prefetch=prefetch)
         except Exception:
             pass
-    return Endpoint(mode, prefetch=prefetch).connect(addr)
+    return Endpoint(mode, prefetch=prefetch).connect(addr, retries=retries)
 
 
 class Device:

@@ -1,0 +1,29 @@
+"""Compatibility shims for the installed jax version.
+
+The device plane uses ``shard_map``, whose public surface moved across
+jax releases: newer jax exports ``jax.shard_map`` with a ``check_vma``
+kwarg; older releases ship ``jax.experimental.shard_map.shard_map``
+with the same parameter named ``check_rep``. Every fiber_tpu site
+imports from here so the repo runs against either — a hard constraint
+of the environment (no pip installs; the baked-in jax is what there
+is)."""
+
+import inspect
+
+try:  # newer jax: public alias
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax: experimental home, same semantics
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+_RENAMES = (("check_vma", "check_rep"), ("check_rep", "check_vma"))
+
+
+def shard_map(f, **kwargs):
+    """``shard_map`` with kwarg-name translation: callers may use the
+    modern names; whichever spelling the installed jax understands is
+    what it receives."""
+    for ours, theirs in _RENAMES:
+        if ours in kwargs and ours not in _PARAMS and theirs in _PARAMS:
+            kwargs[theirs] = kwargs.pop(ours)
+    return _shard_map(f, **kwargs)

@@ -138,14 +138,54 @@ _CPU_COLLECTIVE_TIMEOUT_FLAGS = (
 )
 
 
+#: Env var caching the probe verdict ("1"/"0") so one interpreter tree
+#: pays the subprocess probe at most once.
+_COLLECTIVE_FLAGS_OK_ENV = "FIBER_XLA_COLLECTIVE_FLAGS_OK"
+
+
+def _xla_accepts_collective_flags() -> bool:
+    """True if the installed jaxlib's XLA knows the collective-timeout
+    flags. XLA's env-flag parser calls ``abort()`` on any UNKNOWN flag
+    at first backend init — a hard SIGABRT of the whole process, not an
+    exception — so the probe runs in a throwaway interpreter and the
+    verdict is cached in the environment (inherited by every child, so
+    a process tree probes once)."""
+    cached = os.environ.get(_COLLECTIVE_FLAGS_OK_ENV)
+    if cached is not None:
+        return cached == "1"
+    import subprocess
+
+    env = dict(os.environ,
+               XLA_FLAGS=" ".join(_CPU_COLLECTIVE_TIMEOUT_FLAGS),
+               JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # boot without device plugins
+    code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "jax.devices()")
+    try:
+        ok = subprocess.run(
+            [sys.executable, "-c", code], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            timeout=120,
+        ).returncode == 0
+    except Exception:
+        ok = False
+    os.environ[_COLLECTIVE_FLAGS_OK_ENV] = "1" if ok else "0"
+    return ok
+
+
 def ensure_cpu_collective_timeout_flags() -> None:
     """Append the CPU-collective timeout policy to ``XLA_FLAGS`` —
     per flag, and only where the caller has not already set that flag
     (an explicit caller policy must win). Call BEFORE the first jax
     backend initialization; every CPU-mesh entry point (test conftest,
-    the driver graft entry, record scripts) routes through here."""
+    the driver graft entry, record scripts) routes through here.
+
+    Jaxlib builds that predate these flags ABORT the process on them
+    (XLA treats unknown env flags as fatal), which is strictly worse
+    than the starved-collective hang they mitigate — so the flags are
+    only added when a subprocess probe shows this XLA accepts them."""
     flags = os.environ.get("XLA_FLAGS", "")
     added = [f for f in _CPU_COLLECTIVE_TIMEOUT_FLAGS
              if f.split("=", 1)[0] not in flags]
-    if added:
+    if added and _xla_accepts_collective_flags():
         os.environ["XLA_FLAGS"] = (flags + " " + " ".join(added)).strip()

@@ -41,12 +41,21 @@ from multiprocessing.connection import answer_challenge, deliver_challenge
 from multiprocessing.context import AuthenticationError
 from typing import Callable, Optional
 
+from fiber_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
 #: Max connections allowed to sit in the unauthenticated handshake at
 #: once; further connects are dropped immediately (they can retry).
 DEFAULT_PREAUTH_CAP = 64
 
 #: Absolute bound on one handshake, seconds.
 HANDSHAKE_DEADLINE = 15.0
+
+#: Floor between "peer failed authentication" warnings per serve loop: a
+#: misconfigured real peer retries in a tight loop (and a hostile one
+#: floods), so the diagnostic must not amplify into the log.
+AUTH_WARN_INTERVAL = 5.0
 
 
 def _on_description(conn, fn) -> None:
@@ -75,6 +84,34 @@ def _set_rcvtimeo(conn, seconds: int) -> None:
 def _force_eof(conn) -> None:
     """Wake any blocked read on ``conn`` with EOF (deadline timer)."""
     _on_description(conn, lambda s: s.shutdown(socket.SHUT_RDWR))
+
+
+class RateLimiter:
+    """At most one ``allow()`` per ``min_interval`` seconds
+    (thread-safe); everything else returns False. For log lines whose
+    trigger an attacker (or a retry loop) controls."""
+
+    def __init__(self, min_interval: float) -> None:
+        self._min_interval = float(min_interval)
+        self._last = None
+        self._lock = threading.Lock()
+
+    def allow(self) -> bool:
+        now = time.monotonic()
+        with self._lock:
+            if self._last is not None \
+                    and now - self._last < self._min_interval:
+                return False
+            self._last = now
+            return True
+
+
+def _peer_name(conn) -> str:
+    """Best-effort peer address of a multiprocessing Connection (via a
+    dup'd fd — Connection itself doesn't expose it)."""
+    out = []
+    _on_description(conn, lambda s: out.append(s.getpeername()))
+    return "%s:%s" % out[0][:2] if out else "<unknown>"
 
 
 class PreauthPool:
@@ -122,6 +159,43 @@ class PreauthPool:
             return True
 
 
+class HandshakeDeadline:
+    """Arbiter between a handshake's deadline timer and its success
+    path. ``expire()`` (the timer callback) and ``settle()`` (the
+    success path) are mutually exclusive under a lock: whichever wins,
+    the loser observes it — an expired deadline can never shut down a
+    socket the success path already returned True for, and a success
+    that lost the photo-finish returns False instead of handing the
+    serve loop a conn the timer is about to (or already did) kill."""
+
+    def __init__(self, conn) -> None:
+        self._conn = conn
+        self._lock = threading.Lock()
+        self._fired = False
+        self._settled = False
+
+    def expire(self) -> None:
+        with self._lock:
+            if self._settled:
+                return  # success already returned; the socket is theirs
+            self._fired = True
+        _force_eof(self._conn)
+
+    def settle(self) -> bool:
+        """Claim success; False if the deadline fired first (the socket
+        may be half-dead — treat the handshake as failed)."""
+        with self._lock:
+            if self._fired:
+                return False
+            self._settled = True
+            return True
+
+    @property
+    def fired(self) -> bool:
+        with self._lock:
+            return self._fired
+
+
 def authenticate(conn, authkey: bytes,
                  deadline: float = HANDSHAKE_DEADLINE) -> bool:
     """Run the mutual HMAC challenge with hard time bounds; True on
@@ -129,17 +203,13 @@ def authenticate(conn, authkey: bytes,
     connection is simply not authenticated — the caller closes it.
 
     A handshake that finishes in a photo-finish with the deadline
-    counts as FAILED: the timer may already have shut the socket down
-    concurrently with the success path, and returning True for a
-    half-dead connection would hand the serve loop a conn that EOFs
-    on its first recv."""
-    fired = threading.Event()
-
-    def expire() -> None:
-        fired.set()
-        _force_eof(conn)
-
-    timer = threading.Timer(deadline, expire)
+    counts as FAILED, and the two outcomes are mutually exclusive under
+    :class:`HandshakeDeadline`'s lock: a fired timer can never overlap
+    a True return (before the lock, the timer could shut the socket
+    down a microsecond after the success check passed, handing the
+    serve loop a conn that EOFs on its first recv)."""
+    arbiter = HandshakeDeadline(conn)
+    timer = threading.Timer(deadline, arbiter.expire)
     timer.daemon = True
     timer.start()
     try:
@@ -147,7 +217,7 @@ def authenticate(conn, authkey: bytes,
         deliver_challenge(conn, authkey)
         answer_challenge(conn, authkey)
         _set_rcvtimeo(conn, 0)  # authenticated: block indefinitely again
-        return not fired.is_set()
+        return arbiter.settle()
     except (AuthenticationError, EOFError, OSError, ValueError):
         return False
     finally:
@@ -174,13 +244,27 @@ def serve_authenticated(listener, authkey: bytes,
     Flood posture is EVICT-OLDEST, not drop-newest (see
     :class:`PreauthPool` for the protocol and its invariants)."""
     pool = PreauthPool(preauth_cap)
+    warn_limiter = RateLimiter(AUTH_WARN_INTERVAL)
 
     def guarded(conn) -> None:
+        peer = _peer_name(conn)  # unreadable after close
         ok = authenticate(
             conn, authkey,
             deadline if deadline is not None else HANDSHAKE_DEADLINE)
         evicted = pool.complete(conn)
         if not ok or evicted:
+            # Never silent for REAL peers (same posture as the admin
+            # plane and tcp.py): this close RESETS the dialing client,
+            # which then reports only a bare connection error — the log
+            # line here is the only place "your FIBER_CLUSTER_KEY
+            # doesn't match" survives server-side. Rate-limited, since
+            # the trigger is attacker-controllable; evicted flood
+            # holders fail by design and are not logged at all.
+            if not evicted and warn_limiter.allow():
+                logger.warning(
+                    "%s: rejecting peer %s that failed authentication "
+                    "(mismatched FIBER_CLUSTER_KEY, or handshake "
+                    "timeout)", thread_name, peer)
             try:
                 conn.close()
             except OSError:

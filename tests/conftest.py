@@ -57,6 +57,14 @@ import pytest  # noqa: E402
 import fiber_tpu  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running chaos/soak tests (excluded from tier 1; "
+        "run via `make chaos`)",
+    )
+
+
 @pytest.fixture(autouse=True)
 def leak_check():
     assert fiber_tpu.active_children() == [], "leaked processes from earlier test"

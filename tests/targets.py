@@ -268,12 +268,14 @@ def jax_distributed_psum_check(rank, size):
 
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    from fiber_tpu.utils.jaxcompat import shard_map
+
     mesh = Mesh(np.array(jax.devices()), ("x",))
     sharding = NamedSharding(mesh, P("x"))
     x = jax.make_array_from_callback(
         (n,), sharding, lambda idx: np.arange(n, dtype=np.float32)[idx]
     )
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         lambda v: jax.lax.psum(v, "x"),
         mesh=mesh, in_specs=P("x"), out_specs=P(),
     ))
@@ -343,13 +345,15 @@ def jax_distributed_es_step(rank, size):
     digest = float(np.sum(local_params * np.arange(1, len(local_params) + 1)))
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from fiber_tpu.utils.jaxcompat import shard_map
+
     n = len(jax.devices())
     sharding = NamedSharding(mesh, P("pool"))
     digests = jax.make_array_from_callback(
         (n,), sharding,
         lambda idx: np.full((1,), digest, dtype=np.float32),
     )
-    spread_fn = jax.jit(jax.shard_map(
+    spread_fn = jax.jit(shard_map(
         lambda v: jax.lax.pmax(v.ravel()[0], "pool")
         - jax.lax.pmin(v.ravel()[0], "pool"),
         mesh=mesh, in_specs=P("pool"), out_specs=P(),

@@ -1423,7 +1423,7 @@ def test_ring_attention_local_composes_2d_data_seq_mesh():
 
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from fiber_tpu.utils.jaxcompat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from fiber_tpu.ops import ring_attention_local
@@ -1465,7 +1465,7 @@ def test_ulysses_attention_local_composes_2d_data_seq_mesh():
     import functools
 
     import jax
-    from jax import shard_map
+    from fiber_tpu.utils.jaxcompat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from fiber_tpu.ops import ulysses_attention_local

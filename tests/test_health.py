@@ -1,0 +1,168 @@
+"""Unit tests for the health-plane primitives (fiber_tpu/health.py):
+heartbeater emission/gating, deadline failure detection, and the spawn
+circuit breaker's closed → open → half-open → closed cycle."""
+
+import threading
+import time
+
+from fiber_tpu.health import CircuitBreaker, FailureDetector, Heartbeater
+
+
+def _wait_for(predicate, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return predicate()
+
+
+def test_heartbeater_emits_at_interval():
+    beats = []
+    hb = Heartbeater(lambda: beats.append(time.monotonic()), 0.05).start()
+    try:
+        assert _wait_for(lambda: len(beats) >= 3)
+    finally:
+        hb.stop()
+
+
+def test_heartbeater_gate_skips_beats():
+    beats = []
+    gate_open = threading.Event()
+    hb = Heartbeater(lambda: beats.append(1), 0.02,
+                     gate=gate_open.is_set).start()
+    try:
+        time.sleep(0.2)
+        assert beats == []  # gate closed: a hung host emits nothing
+        gate_open.set()
+        assert _wait_for(lambda: len(beats) >= 2)
+    finally:
+        hb.stop()
+
+
+def test_heartbeater_stops_on_oserror():
+    calls = []
+
+    def emit():
+        calls.append(1)
+        raise OSError("channel gone")
+
+    hb = Heartbeater(emit, 0.02).start()
+    time.sleep(0.3)
+    assert len(calls) == 1  # one failed emit, then the thread exits
+    assert not hb._thread.is_alive()
+
+
+def test_heartbeater_timeout_is_skip_not_stop():
+    calls = []
+
+    def emit():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TimeoutError("congested")
+
+    hb = Heartbeater(emit, 0.02).start()
+    try:
+        assert _wait_for(lambda: len(calls) >= 4)
+    finally:
+        hb.stop()
+
+
+def test_detector_declares_silent_peer_and_ignores_late_beats():
+    suspected = []
+    det = FailureDetector(0.15, suspected.append, permanent=True).start()
+    try:
+        det.beat("w1")
+        det.beat("w2")
+        # keep w2 alive while w1 goes silent
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline and "w1" not in suspected:
+            det.beat("w2")
+            time.sleep(0.02)
+        assert suspected == ["w1"]
+        assert det.is_suspect("w1") and not det.is_suspect("w2")
+        # permanent: a late beat from the declared peer changes nothing,
+        # and it is never re-suspected (no duplicate declaration)
+        det.beat("w1")
+        deadline = time.monotonic() + 0.3
+        while time.monotonic() < deadline:
+            det.beat("w2")  # keep the live peer live
+            time.sleep(0.02)
+        assert suspected == ["w1"]
+        assert det.suspected_total == 1
+    finally:
+        det.stop()
+
+
+def test_detector_forget_prevents_postmortem_suspicion():
+    suspected = []
+    det = FailureDetector(0.1, suspected.append).start()
+    try:
+        det.beat("w1")
+        det.forget("w1")  # death observed through another path
+        time.sleep(0.4)
+        assert suspected == []
+    finally:
+        det.stop()
+
+
+def test_detector_revives_nonpermanent_peers():
+    """Host-agent mode: a suspected host that answers again is revived
+    and can be suspected again on the next silence."""
+    suspected = []
+    det = FailureDetector(0.12, suspected.append, permanent=False).start()
+    try:
+        det.beat("host")
+        assert _wait_for(lambda: suspected.count("host") == 1, 2.0)
+        assert det.is_suspect("host")
+        det.beat("host")  # agent restarted
+        assert not det.is_suspect("host")
+        assert _wait_for(lambda: suspected.count("host") == 2, 2.0)
+    finally:
+        det.stop()
+
+
+def test_breaker_full_cycle():
+    br = CircuitBreaker(fail_threshold=2, base_backoff=0.1,
+                        max_backoff=0.3, jitter=0.0)
+    key = "host-a"
+    assert br.allow(key) and br.state(key) == "closed"
+    assert not br.record_failure(key)
+    assert br.allow(key)  # below threshold: still closed
+    assert br.record_failure(key)  # threshold reached: opens
+    assert br.state(key) == "open"
+    assert not br.allow(key)
+    time.sleep(0.12)
+    assert br.state(key) == "half-open"
+    assert br.allow(key)  # half-open admits a trial
+    # the trial fails: reopens immediately (no fresh threshold count)
+    assert br.record_failure(key)
+    assert not br.allow(key)
+    time.sleep(0.25)  # doubled backoff expired
+    assert br.allow(key)
+    br.record_success(key)
+    assert br.state(key) == "closed"
+    assert br.opened_total == 2
+
+
+def test_breaker_keys_are_independent():
+    br = CircuitBreaker(fail_threshold=1, base_backoff=5.0,
+                        max_backoff=5.0, jitter=0.0)
+    assert br.record_failure("bad-host")
+    assert not br.allow("bad-host")
+    assert br.allow("good-host")  # untouched key stays closed
+
+
+def test_breaker_backoff_caps_and_jitters():
+    import random
+
+    br = CircuitBreaker(fail_threshold=1, base_backoff=0.1,
+                        max_backoff=0.2, jitter=0.5,
+                        rng=random.Random(7))
+    for _ in range(6):
+        br.record_failure("k")
+    # 0.1 * 2^5 would be 3.2s; the cap plus full jitter bounds it at
+    # 0.2 * 1.5 = 0.3s from "now"
+    with br._lock:
+        remaining = br._state["k"][2] - time.monotonic()
+    assert remaining <= 0.31, remaining

@@ -2,11 +2,13 @@
 shared defense of the agent and managers RPC planes against hostile
 clients."""
 
+import logging
 import socket
 import threading
 import time
 
 from multiprocessing.connection import Client, Listener
+from multiprocessing.context import AuthenticationError
 
 from fiber_tpu.utils import serve
 
@@ -125,6 +127,101 @@ def test_preauth_cap_sheds_flood_but_serves_real_client():
         stop.set()
         listener.close()
         # drain the parked accept so the loop thread exits
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.5).close()
+        except OSError:
+            pass
+        t.join(10)
+
+
+def test_handshake_deadline_settle_wins_photo_finish():
+    """Regression: expire() and the success return are mutually
+    exclusive. Once settle() claimed success, a late-firing timer must
+    NOT shut the socket down — before the lock, the timer could kill a
+    connection authenticate() had already blessed."""
+    a, b = socket.socketpair()
+    try:
+        arbiter = serve.HandshakeDeadline(a)
+        assert arbiter.settle() is True
+        arbiter.expire()  # the timer losing the photo-finish
+        assert not arbiter.fired
+        # the socket survived: expire() did not shutdown(2) it
+        b.sendall(b"ping")
+        a.settimeout(5.0)
+        assert a.recv(4) == b"ping"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_handshake_deadline_expire_wins_photo_finish():
+    """Regression (the other half): once the deadline fired, a
+    handshake that completes anyway must be reported FAILED — the
+    socket may already be half-dead."""
+    a, b = socket.socketpair()
+    try:
+        arbiter = serve.HandshakeDeadline(a)
+        arbiter.expire()
+        assert arbiter.fired
+        assert arbiter.settle() is False
+    finally:
+        a.close()
+        b.close()
+
+
+class _RecordingHandler(logging.Handler):
+    def __init__(self):
+        super().__init__(level=logging.WARNING)
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+def test_real_auth_failure_logged_rate_limited():
+    """Regression: a REAL peer failing the HMAC challenge (mismatched
+    FIBER_CLUSTER_KEY) must leave a server-side warning — previously the
+    conn was closed silently and the operator saw only client-side
+    resets — and the warning is rate-limited so a retry loop (or flood)
+    cannot amplify into the log. (The fiber_tpu logger doesn't
+    propagate, so capture with an explicit handler.)"""
+    handler = _RecordingHandler()
+    flogger = logging.getLogger("fiber_tpu")
+    flogger.addHandler(handler)
+    listener = Listener(("127.0.0.1", 0))
+    port = listener.address[1]
+    stop = threading.Event()
+    served = []
+
+    t = threading.Thread(
+        target=serve.serve_authenticated,
+        args=(listener, KEY, stop, served.append, "test-auth-warn"),
+        kwargs={"deadline": 2.0},
+        daemon=True,
+    )
+    t.start()
+
+    def hits():
+        return [r for r in handler.records
+                if "failed authentication" in r.getMessage()]
+
+    try:
+        for _ in range(3):  # three wrong-key peers, back to back
+            try:
+                Client(("127.0.0.1", port), authkey=b"wrong-key")
+            except (AuthenticationError, EOFError, OSError):
+                pass
+        deadline = time.time() + 10
+        while time.time() < deadline and not hits():
+            time.sleep(0.05)
+        time.sleep(0.5)  # allow any (wrongly) unthrottled extras to land
+        # logged at least once, but rate-limited below the failure count
+        assert len(hits()) == 1, [r.getMessage() for r in hits()]
+        assert served == []
+    finally:
+        flogger.removeHandler(handler)
+        stop.set()
+        listener.close()
         try:
             socket.create_connection(("127.0.0.1", port), 0.5).close()
         except OSError:

@@ -3,7 +3,7 @@
 
 PYTEST ?= python -m pytest tests/ -q
 
-.PHONY: test stest test-all lint bench weakscale docs chaos
+.PHONY: test stest test-all lint bench bench-store weakscale docs chaos
 
 # Tier 1: local backend (subprocess jobs)
 test:
@@ -38,6 +38,14 @@ chaos:
 # records it).
 bench:
 	FIBER_BENCH_ENFORCE=1 python bench.py
+
+# Object-store data-plane microbench (docs/objectstore.md): local
+# put/get + wire fetch throughput, and broadcast bytes-per-task with
+# the by-reference pool path on vs off. Pure host plane — runs on the
+# CPU platform; JSON-lines record lands next to the driver's BENCH
+# files.
+bench-store:
+	JAX_PLATFORMS=cpu python bench.py --store | tee BENCH_store.json
 
 # Weak-scaling record over 1/2/4/8-device sim meshes (fused ES,
 # population scaled with devices) + strong curve (constant total pop)

@@ -124,6 +124,104 @@ def _watchdog(seconds: float, payload: dict, fallback_cpu: bool = False):
     return timer
 
 
+def _store_bench(args) -> int:
+    """Object-store microbench (docs/objectstore.md). Emits one JSON
+    line per metric; `make bench-store` tees them into
+    BENCH_store.json next to the driver's BENCH records.
+
+    Sections: (1) LocalStore put/get throughput (serialization envelope
+    + content addressing included — that IS the put cost); (2) wire
+    fetch throughput through the chunked store plane on loopback;
+    (3) the headline: broadcast bytes-per-task over a real Pool.map
+    with the by-reference plane ON vs OFF, plus wall-clock for both."""
+    import time
+
+    import numpy as np
+
+    payload_mb = float(args.store_mb)
+    arr = np.random.default_rng(0).standard_normal(
+        int(payload_mb * (1 << 20) / 4)).astype(np.float32)
+
+    from fiber_tpu import serialization
+    from fiber_tpu.store import LocalStore
+    from fiber_tpu.store.plane import StoreClient, StoreServer
+
+    # -- 1) local tier ------------------------------------------------
+    blob = serialization.dumps(arr)
+    st = LocalStore(capacity_bytes=512 << 20)
+    reps = 8
+    t0 = time.perf_counter()
+    for i in range(reps):
+        # vary one byte so content addressing can't dedup the timing
+        st.put_bytes(blob[:-1] + bytes([i]))
+    put_s = (time.perf_counter() - t0) / reps
+    ref = st.put_bytes(blob)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        st.get_bytes(ref.digest)
+    get_s = (time.perf_counter() - t0) / reps
+    _emit({"metric": "store_put_mb_per_sec",
+           "value": round(payload_mb / put_s, 1), "unit": "MB/s",
+           "payload_mb": payload_mb})
+    _emit({"metric": "store_get_mb_per_sec",
+           "value": round(payload_mb / get_s, 1), "unit": "MB/s",
+           "payload_mb": payload_mb})
+
+    # -- 2) wire plane ------------------------------------------------
+    server = StoreServer(st, "127.0.0.1")
+    client = StoreClient(LocalStore(capacity_bytes=512 << 20))
+    wire_ref = type(ref)(ref.digest, ref.size, server.addr)
+    t0 = time.perf_counter()
+    client.fetch_bytes(wire_ref)
+    wire_s = time.perf_counter() - t0
+    _emit({"metric": "store_wire_fetch_mb_per_sec",
+           "value": round(payload_mb / wire_s, 1), "unit": "MB/s",
+           "payload_mb": payload_mb})
+    client.close()
+    server.close()
+
+    # -- 3) broadcast bytes-per-task, pool path on vs off -------------
+    import fiber_tpu
+    from tests import targets  # arr_sum_plus: importable in workers
+
+    n_tasks = int(args.store_tasks)
+    items = [(arr, i) for i in range(n_tasks)]
+    record = {}
+    for mode in ("off", "on"):
+        fiber_tpu.init(store_enabled=(mode == "on"))
+        with fiber_tpu.Pool(2) as pool:
+            before = pool.store_stats()
+            t0 = time.perf_counter()
+            out = pool.starmap(targets.arr_sum_plus, items, chunksize=2)
+            wall = time.perf_counter() - t0
+            after = pool.store_stats()
+        assert len(out) == n_tasks
+        if mode == "off":
+            # Inline wire cost per task: the actual chunk frame bytes
+            # (the broadcast arg is re-pickled into EVERY chunk).
+            chunk = serialization.dumps(items[:2])
+            record["before_bytes"] = len(chunk) / 2
+            record["before_wall"] = wall
+        else:
+            served = after.get("bytes_served", 0) - \
+                before.get("bytes_served", 0)
+            record["after_bytes"] = served / n_tasks
+            record["after_wall"] = wall
+    fiber_tpu.init()
+    _emit({"metric": "store_broadcast_bytes_per_task_before",
+           "value": round(record["before_bytes"], 1), "unit": "bytes",
+           "tasks": n_tasks, "payload_mb": payload_mb,
+           "wall_s": round(record["before_wall"], 3)})
+    _emit({"metric": "store_broadcast_bytes_per_task_after",
+           "value": round(record["after_bytes"], 1), "unit": "bytes",
+           "tasks": n_tasks, "payload_mb": payload_mb,
+           "wall_s": round(record["after_wall"], 3),
+           "reduction_x": round(
+               record["before_bytes"] / max(record["after_bytes"], 1),
+               1)})
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--platform", default="",
@@ -163,6 +261,18 @@ def main() -> int:
                         help="bench long-context TRAINING instead: TinyLM "
                              "optimizer steps (fwd+bwd+adamw) with the "
                              "sequence ring-sharded at --seq tokens")
+    parser.add_argument("--store", action="store_true",
+                        help="bench the object-store data plane instead "
+                             "(docs/objectstore.md): local put/get "
+                             "throughput, wire fetch throughput, and "
+                             "broadcast bytes-per-task with the "
+                             "by-reference pool path on vs off; pure "
+                             "host plane (runs on JAX_PLATFORMS=cpu)")
+    parser.add_argument("--store-mb", type=float, default=8.0,
+                        help="broadcast payload size for --store, MB")
+    parser.add_argument("--store-tasks", type=int, default=64,
+                        help="task count for the --store broadcast "
+                             "section")
     parser.add_argument("--profile", default="",
                         help="write a jax.profiler trace of the timed ES "
                              "section to this directory (inspect with "
@@ -173,9 +283,13 @@ def main() -> int:
     if args.gens < 1:
         parser.error("--gens must be >= 1")
     if sum((args.poet, args.pixels, args.biped, args.attention,
-            args.lm)) > 1:
-        parser.error("--poet/--pixels/--biped/--attention/--lm are "
-                     "mutually exclusive")
+            args.lm, args.store)) > 1:
+        parser.error("--poet/--pixels/--biped/--attention/--lm/--store "
+                     "are mutually exclusive")
+    if args.store:
+        # Host-plane only: no accelerator probe, no watchdog — the
+        # store bench must run identically on a laptop and a pod host.
+        return _store_bench(args)
     if args.pop is not None and args.pop < 2:
         parser.error("--pop must be >= 2")
     if args.steps is not None and args.steps < 1:

@@ -483,6 +483,37 @@ class TpuBackend(Backend):
         host = host or self._hosts[0]
         return self._agent(host).call("get_file", path)
 
+    # -- object store (docs/objectstore.md) ----------------------------
+    def put_object(self, digest: str, data: bytes, hosts=None) -> int:
+        """Prestage one serialized store object into every host's cache
+        tier (skipping hosts that already have it): workers there
+        resolve the ref from local disk instead of dialing the owner —
+        the explicit broadcast path for very hot objects. Returns the
+        number of hosts that received bytes."""
+        pushed = 0
+        for host in (hosts or self._hosts):
+            agent = self._agent(host)
+            try:
+                if agent.call("store_has", digest):
+                    continue
+            except Exception:
+                pass  # can't tell; push anyway
+            agent.call("store_put", digest, bytes(data))
+            pushed += 1
+        return pushed
+
+    def store_stats(self) -> Dict[str, dict]:
+        """Per-host object-cache counters, the store-plane sibling of
+        :meth:`host_health` (same operator surface, same host keys)."""
+        out: Dict[str, dict] = {}
+        for host in self._hosts:
+            key = f"{host[0]}:{host[1]}"
+            try:
+                out[key] = self._agent(host).call("store_stats")
+            except Exception as exc:  # noqa: BLE001 - operator snapshot
+                out[key] = {"error": repr(exc)}
+        return out
+
 
 def make_backend() -> TpuBackend:
     return TpuBackend()

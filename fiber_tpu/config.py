@@ -56,6 +56,22 @@ DEFAULTS: Dict[str, Any] = {
     "spawn_breaker_backoff_max": 2.0,
     # --- data plane ---
     "use_push_queue": True,
+    # --- object store (docs/objectstore.md) ---
+    # By-reference task data plane: pool args/results whose serialized
+    # size exceeds store_inline_max bytes travel as ObjectRefs through
+    # the per-host object store instead of riding every task frame.
+    # 0 disables the store (everything ships inline), as does
+    # store_enabled=False.
+    "store_enabled": True,
+    "store_inline_max": 512 * 1024,
+    # Host-RAM LRU capacity of the local store, MB; colder objects spill
+    # to disk under store_dir.
+    "store_capacity_mb": 512,
+    # Content-addressed object directory shared by every fiber process
+    # on a host (fetch dedup + spill). "" = <staging root>/objects,
+    # where the staging root is FIBER_AGENT_STAGING or
+    # ~/.fiber_tpu/staging (utils/staging.py / host_agent.py).
+    "store_dir": "",
     # Strip accelerator runtime preloads from spawned host workers (faster
     # interpreter boot; only for workers that never touch the device).
     "worker_lite": False,

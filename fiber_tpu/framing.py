@@ -24,38 +24,47 @@ class ConnectionClosed(OSError):
     """Peer closed the connection mid-frame or before a frame."""
 
 
-def send_frame(sock: socket.socket, payload: bytes, prefix: bytes = b"") -> None:
+def send_frame(sock: socket.socket, payload, prefix: bytes = b"") -> None:
     """Send one frame; ``prefix`` rides inside the frame before the payload
     (used by the transport for its 1-byte frame-type tag) without copying
-    large payloads."""
+    large payloads. ``payload`` may be any bytes-like (the object-store
+    plane streams memoryview slices)."""
     header = _LEN.pack(len(payload) + len(prefix))
     if len(payload) > 65536:
         # Avoid duplicating large payloads (host-plane tensors) in memory.
         sock.sendall(header + prefix)
         sock.sendall(payload)
     else:
-        sock.sendall(header + prefix + payload)
+        sock.sendall(header + prefix + bytes(payload))
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    remaining = n
-    while remaining:
-        chunk = sock.recv(min(remaining, 1 << 20))
-        if not chunk:
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    """Read exactly ``n`` bytes into one preallocated buffer.
+
+    ``recv_into`` against a single bytearray instead of accumulating
+    chunks + ``b"".join(...)``: the old path held every chunk AND the
+    joined copy alive at once — 2x peak memory on large frames (host-
+    plane tensors). The returned bytearray is freshly allocated and
+    never aliased, so handing it to callers (which treat frames as
+    read-only bytes-likes) is safe."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        nread = sock.recv_into(view[got:], min(n - got, 1 << 20))
+        if not nread:
             raise ConnectionClosed("connection closed while reading frame")
-        chunks.append(chunk)
-        remaining -= len(chunk)
-    return b"".join(chunks)
+        got += nread
+    return buf
 
 
-def recv_frame(sock: socket.socket) -> bytes:
+def recv_frame(sock: socket.socket) -> bytearray:
     header = _recv_exact(sock, _LEN.size)
     (length,) = _LEN.unpack(header)
     if length > MAX_FRAME:
         raise OSError(f"frame too large: {length}")
     if length == 0:
-        return b""
+        return bytearray()
     return _recv_exact(sock, length)
 
 

@@ -379,6 +379,77 @@ class HostAgent:
         with open(self._file_path(path), "rb") as fh:
             return fh.read()
 
+    # -- object store (fiber_tpu/store, docs/objectstore.md) -----------
+    # The agent serves the HOST CACHE tier of the per-host object store:
+    # masters prestage broadcast objects through these ops so workers on
+    # this host resolve refs from local disk without ever dialing the
+    # owner, and operators inspect/clean the cache remotely. The
+    # directory is the same `<staging>/objects` the in-process
+    # LocalStore spills into.
+    @staticmethod
+    def _check_digest(digest: str) -> str:
+        from fiber_tpu.utils.staging import is_object_digest
+
+        if not is_object_digest(digest):
+            raise ValueError(f"malformed object digest {digest!r}")
+        return digest
+
+    def _object_path(self, digest: str) -> str:
+        return os.path.join(self._staging_root, "objects",
+                            f"{self._check_digest(digest)}.obj")
+
+    def _op_store_put(self, digest: str, data: bytes) -> int:
+        import hashlib
+
+        if hashlib.sha256(data).hexdigest() != self._check_digest(digest):
+            raise ValueError("object payload does not match its digest")
+        path = self._object_path(digest)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                   suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                fh.write(data)
+            os.replace(tmp, path)  # atomic: readers see complete objects
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return len(data)
+
+    def _op_store_get(self, digest: str) -> bytes:
+        with open(self._object_path(digest), "rb") as fh:
+            return fh.read()
+
+    def _op_store_has(self, digest: str) -> bool:
+        return os.path.exists(self._object_path(digest))
+
+    def _op_store_delete(self, digest: str) -> bool:
+        try:
+            os.unlink(self._object_path(digest))
+            return True
+        except OSError:
+            return False
+
+    def _op_store_stats(self) -> dict:
+        root = os.path.join(self._staging_root, "objects")
+        count = 0
+        total = 0
+        try:
+            for name in os.listdir(root):
+                if not name.endswith(".obj"):
+                    continue
+                try:
+                    total += os.path.getsize(os.path.join(root, name))
+                    count += 1
+                except OSError:
+                    continue
+        except OSError:
+            pass
+        return {"objects": count, "bytes": total}
+
     def _op_host_info(self) -> dict:
         return {
             "pid": os.getpid(),

@@ -274,13 +274,16 @@ def ring_attention_local(q_blk, k_blk, v_blk, *, axis: str,
     batch shard, and every sequence still spans the full seq axis. It
     also composes with ``vmap`` and jax AD (gradient parity with full
     attention is pinned in tests). ``n_devices`` defaults to the bound
-    axis's true size (``jax.lax.axis_size``) — pass it only to
+    axis's true size (the ``axis_size`` shim in utils/jaxcompat —
+    ``jax.lax.axis_size`` only exists on newer jax) — pass it only to
     override, and beware a mismatch silently drops KV blocks.
     """
     import jax
     import jax.numpy as jnp
 
-    n_dev = (int(jax.lax.axis_size(axis)) if n_devices is None
+    from fiber_tpu.utils.jaxcompat import axis_size
+
+    n_dev = (axis_size(axis) if n_devices is None
              else n_devices)
     if local == "flash":
         return _ring_flash_local(q_blk, k_blk, v_blk, axis=axis,

@@ -38,6 +38,8 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from fiber_tpu import serialization
 from fiber_tpu.meta import get_meta
+from fiber_tpu.store.core import ObjectRef
+from fiber_tpu.store.plane import StoreFetchError
 from fiber_tpu.testing import chaos
 from fiber_tpu.transport import Endpoint, TransportClosed
 from fiber_tpu.utils.logging import get_logger
@@ -394,6 +396,81 @@ class _ResultIterator:
 
 
 # ---------------------------------------------------------------------------
+# By-reference payloads (fiber_tpu/store): args/results above
+# store_inline_max travel as ObjectRefs; workers resolve them through the
+# per-host store so a broadcast arg crosses the wire once per host, not
+# once per task (docs/objectstore.md).
+# ---------------------------------------------------------------------------
+
+
+def _payload_size_hint(obj: Any) -> Optional[int]:
+    """Cheap serialized-size estimate, or None when only a real pickle
+    can tell. The point is to never pay a probe pickle for the common
+    small scalars nor for the numpy/jax arrays whose size is a field
+    read; unknown container types fall through to the probe."""
+    if obj is None or isinstance(obj, (bool, int, float, complex)):
+        return 0
+    if isinstance(obj, (bytes, bytearray, memoryview, str)):
+        return len(obj)
+    try:
+        nbytes = getattr(obj, "nbytes", None)  # numpy / jax arrays
+        if nbytes is not None:
+            return int(nbytes)
+    except Exception:  # noqa: BLE001 - exotic objects; just probe
+        pass
+    return None
+
+
+def _chunk_has_refs(chunk: List[Any]) -> bool:
+    for item in chunk:
+        if isinstance(item, ObjectRef):
+            return True
+        if type(item) is tuple and any(
+                isinstance(e, ObjectRef) for e in item):
+            return True
+    return False
+
+
+def _resolve_item(item: Any, client) -> Any:
+    """Replace ObjectRefs (top level, or one tuple level deep — exactly
+    where the encoder puts them) with the resolved objects. Raises
+    StoreFetchError when a ref cannot be resolved from any tier."""
+    if isinstance(item, ObjectRef):
+        return client.resolve(item)
+    if type(item) is tuple and any(
+            isinstance(e, ObjectRef) for e in item):
+        return tuple(client.resolve(e) if isinstance(e, ObjectRef) else e
+                     for e in item)
+    return item
+
+
+def _encode_results(values: List[Any], get_client, store_addr: str,
+                    inline_max: int) -> List[Any]:
+    """Worker-side result encoding: push results above the threshold to
+    the master's store and ship the ref. Every failure falls back to
+    inline shipping — the store is an optimization, never a correctness
+    dependency."""
+    for i, v in enumerate(values):
+        if isinstance(v, (_Failure, ObjectRef)):
+            continue
+        hint = _payload_size_hint(v)
+        if hint is not None and hint <= inline_max:
+            continue
+        try:
+            data = serialization.dumps(v)
+        except Exception:  # noqa: BLE001 - let the inline path raise it
+            continue
+        if len(data) <= inline_max:
+            continue
+        try:
+            values[i] = get_client().push(data, store_addr)
+        except Exception:  # noqa: BLE001
+            logger.warning("store: result push failed; shipping inline",
+                           exc_info=True)
+    return values
+
+
+# ---------------------------------------------------------------------------
 # Worker side
 # ---------------------------------------------------------------------------
 
@@ -448,10 +525,11 @@ def _subworker_main(
     initializer: Optional[Callable],
     initargs: Tuple,
     maxtasksperchild: Optional[int],
+    store_addr: Optional[str],
 ) -> None:
     reason = _pool_worker_core(
         task_addr, result_addr, resilient, initializer, initargs,
-        maxtasksperchild, ident=ident,
+        maxtasksperchild, ident=ident, store_addr=store_addr,
     )
     if reason == "recycle":
         sys.exit(_SUBWORKER_RECYCLE)
@@ -471,6 +549,7 @@ def pool_worker(
     maxtasksperchild: Optional[int],
     n_local: int = 1,
     ctl_addr: Optional[str] = None,
+    store_addr: Optional[str] = None,
 ) -> None:
     """Body of one pool worker process. With ``n_local > 1`` the process
     packs that many OS sub-workers, each dialing the master independently
@@ -498,7 +577,8 @@ def pool_worker(
             c = ctx.Process(
                 target=_subworker_main,
                 args=(ident, task_addr, result_addr, resilient,
-                      initializer, initargs, maxtasksperchild),
+                      initializer, initargs, maxtasksperchild,
+                      store_addr),
                 name=f"fiber-subworker-{i}",
                 daemon=True,
             )
@@ -616,7 +696,7 @@ def pool_worker(
         return
     _pool_worker_core(
         task_addr, result_addr, resilient, initializer, initargs,
-        maxtasksperchild,
+        maxtasksperchild, store_addr=store_addr,
     )
 
 
@@ -628,6 +708,7 @@ def _pool_worker_core(
     initargs: Tuple,
     maxtasksperchild: Optional[int],
     ident: Optional[bytes] = None,
+    store_addr: Optional[str] = None,
 ) -> str:
     from fiber_tpu import process as fprocess
 
@@ -663,6 +744,27 @@ def _pool_worker_core(
     reason = "error"
     next_task = None
     heartbeater = None
+    # By-reference payloads: the store client is built lazily on the
+    # first ref actually seen (most workers in small maps never pay the
+    # import), shared across chunks so broadcast args resolve once per
+    # worker process. Result-side threshold mirrors the master's config
+    # (shipped in the spawn preparation).
+    store_client = None
+    store_inline_max = 0
+    if store_addr:
+        from fiber_tpu import config as _wcfg
+
+        _c = _wcfg.get()
+        if _c.store_enabled:
+            store_inline_max = int(_c.store_inline_max)
+
+    def get_store_client():
+        nonlocal store_client
+        if store_client is None:
+            from fiber_tpu import store as storemod
+
+            store_client = storemod.client()
+        return store_client
     if resilient:
         # Health plane: beat on the result stream (the master's result
         # loop already fair-merges it; no extra sockets) so the failure
@@ -744,8 +846,35 @@ def _pool_worker_core(
                 # (so the death strands staged/queued chunks, the
                 # resubmission case worth inducing).
                 plan.maybe_hang_worker(completed_chunks)
+            if _chunk_has_refs(chunk):
+                try:
+                    client = get_store_client()
+                    chunk = [_resolve_item(it, client) for it in chunk]
+                except StoreFetchError as err:
+                    # Degrade, don't fail: ask the master to resend
+                    # this chunk with inline payloads (the store is an
+                    # optimization, never a correctness dependency).
+                    logger.warning(
+                        "store: fetch failed (%s); requesting inline "
+                        "resend of chunk seq=%s base=%s", err, seq, base)
+                    result_ep.send(serialization.dumps(
+                        ("storemiss", seq, base, len(chunk), ident)))
+                    # The handout is consumed even though nothing ran:
+                    # the resilient fetch thread budgets FETCHED chunks
+                    # (maxtasksperchild), so skipping this increment
+                    # would leave the main loop waiting on a chunk the
+                    # fetcher will never deliver.
+                    completed_chunks += 1
+                    if maxtasksperchild \
+                            and completed_chunks >= maxtasksperchild:
+                        reason = "recycle"
+                        break
+                    continue
             fn = funcs.get(digest, blob)
             values = _run_chunk(fn, chunk, star)
+            if store_inline_max > 0:
+                values = _encode_results(values, get_store_client,
+                                         store_addr, store_inline_max)
             result_ep.send(
                 serialization.dumps(("result", seq, base, values, ident))
             )
@@ -819,6 +948,36 @@ class Pool:
         self._result_ep = Endpoint("r")
         self._result_addr = self._result_ep.bind(ip)
 
+        # By-reference data plane (fiber_tpu/store): args/results above
+        # store_inline_max ride as ObjectRefs against this process's
+        # store server. Failure to bring the store up only costs the
+        # optimization — everything ships inline.
+        self._store_inline_max = (
+            int(cfg.store_inline_max) if cfg.store_enabled else 0
+        )
+        self._objstore = None
+        self._store_server = None
+        self._store_addr = None
+        if self._store_inline_max > 0:
+            try:
+                from fiber_tpu import store as storemod
+
+                self._store_server, self._store_addr = \
+                    storemod.ensure_server(ip)
+                self._objstore = self._store_server.store
+            except Exception:  # noqa: BLE001
+                logger.warning(
+                    "object store unavailable; pool ships payloads "
+                    "inline", exc_info=True)
+                self._store_inline_max = 0
+        #: seq -> (func_digest, func_blob, star, original items): kept
+        #: while a ref-bearing map is in flight so a worker that cannot
+        #: resolve a ref gets its chunk resent INLINE (storemiss path)
+        #: instead of failing tasks.
+        self._seq_ctx: Dict[int, Tuple] = {}
+        self._seq_ctx_lock = threading.Lock()
+        self._store_fallbacks = 0
+
         self._store = ResultStore()
         # Items are (payload, (seq, base)) — the key rides alongside so the
         # resilient handout never has to re-deserialize the payload.
@@ -882,6 +1041,7 @@ class Pool:
                 self._maxtasksperchild,
                 n_local,
                 getattr(self, "_ctl_addr", None),
+                self._store_addr,
             ),
             name=f"PoolWorker-{uuid.uuid4().hex[:8]}",
             daemon=True,
@@ -1059,6 +1219,12 @@ class Pool:
                     if detector is not None:
                         detector.beat(msg[1])
                     continue
+                if msg[0] == "storemiss":
+                    _, seq, base, n, ident = msg
+                    if detector is not None:
+                        detector.beat(ident)  # a report proves liveness
+                    self._on_store_miss(seq, base, n, ident)
+                    continue
                 if msg[0] != "result":
                     continue
                 _, seq, base, values, ident = msg
@@ -1068,6 +1234,8 @@ class Pool:
                     # still making progress, and progress must never
                     # read as death.
                     detector.beat(ident)
+                if any(isinstance(v, ObjectRef) for v in values):
+                    values = self._resolve_result_refs(values)
                 self._on_result(seq, base, values, ident)
                 self._store.fill(seq, base, values)
             except Exception:
@@ -1075,6 +1243,138 @@ class Pool:
 
     def _on_result(self, seq, base, values, ident) -> None:
         pass
+
+    # -- by-reference payloads (fiber_tpu/store) ---------------------------
+    def _encode_items(self, items: List[Any],
+                      seq_digests: List[str]) -> List[Any]:
+        """Replace large args with ObjectRefs (top level and one tuple
+        level deep, which covers map-over-tuples and starmap). The memo
+        keys on object identity so the classic broadcast pattern — the
+        same params object in every item — is hashed and stored ONCE
+        per map, not once per task."""
+        memo: Dict[int, Tuple[Any, Any]] = {}
+        return [self._encode_item(it, memo, seq_digests) for it in items]
+
+    def _encode_item(self, item, memo, seq_digests):
+        if type(item) is tuple:
+            return tuple(self._encode_obj(e, memo, seq_digests)
+                         for e in item)
+        return self._encode_obj(item, memo, seq_digests)
+
+    def _encode_obj(self, obj, memo, seq_digests):
+        if isinstance(obj, ObjectRef):
+            return obj  # user pre-put it; ships as-is
+        key = id(obj)
+        hit = memo.get(key)
+        if hit is not None:
+            return hit[1]
+        hint = _payload_size_hint(obj)
+        if hint is not None and hint <= self._store_inline_max:
+            return obj
+        try:
+            data = serialization.dumps(obj)
+        except Exception:  # noqa: BLE001
+            return obj  # let the inline path raise the real error
+        if len(data) <= self._store_inline_max:
+            memo[key] = (obj, obj)
+            return obj
+        ref = self._objstore.put_bytes(data, refs=1,
+                                       owner=self._store_addr)
+        seq_digests.append(ref.digest)
+        # The memo holds the original object alive so its id() cannot
+        # be recycled mid-encode.
+        memo[key] = (obj, ref)
+        return ref
+
+    def _arm_store_fallback(self, seq, digest, blob, star, items,
+                            seq_digests) -> None:
+        """Keep enough context to resend any chunk inline (storemiss),
+        and release the map's store refs when it completes (success,
+        failure or abort — completion callbacks fire on all three)."""
+        with self._seq_ctx_lock:
+            self._seq_ctx[seq] = (digest, blob, star, items)
+
+        def _cleanup() -> None:
+            with self._seq_ctx_lock:
+                self._seq_ctx.pop(seq, None)
+            for d in seq_digests:
+                self._objstore.release(d)
+
+        self._store.add_callback(seq, _cleanup)
+
+    def _on_store_miss(self, seq, base, n, ident) -> None:
+        """A worker could not resolve this chunk's refs (store down,
+        object evicted unspilled, injected chaos): resend the chunk
+        with INLINE payloads. Dedup on fill makes double delivery
+        harmless; a done map is simply dropped."""
+        with self._seq_ctx_lock:
+            ctx = self._seq_ctx.get(seq)
+        if ctx is None or self._store.is_done(seq):
+            return
+        fdigest, blob, star, items = ctx
+        chunk = items[base:base + n]
+        payload = serialization.dumps(
+            ("task", seq, base, fdigest, blob, chunk, star)
+        )
+        self._store_fallbacks += 1
+        logger.warning(
+            "store: worker %s could not resolve refs (seq=%d base=%d); "
+            "resending chunk inline", ident.hex()[:8], seq, base)
+        self._taskq.put((payload, (seq, base)))
+
+    def _resolve_result_refs(self, values: List[Any]) -> List[Any]:
+        """Master-side resolution of by-reference results: this process
+        owns the store the workers pushed to, so resolution is a local
+        read + lifecycle release. A missing/corrupt object fails ONLY
+        the affected slot, catchably."""
+        out = []
+        for v in values:
+            if not isinstance(v, ObjectRef):
+                out.append(v)
+                continue
+            data = (self._objstore.get_bytes(v.digest)
+                    if self._objstore is not None else None)
+            if data is None:
+                out.append(_Failure(
+                    StoreFetchError(
+                        f"result object {v.digest[:12]} missing from "
+                        "the master store"), "", direct=True))
+                continue
+            try:
+                out.append(serialization.loads(data))
+            except Exception as err:  # noqa: BLE001
+                out.append(_Failure(err, traceback.format_exc(),
+                                    direct=True))
+            finally:
+                self._objstore.release(v.digest)
+        return out
+
+    def put_object(self, obj: Any) -> ObjectRef:
+        """Explicitly stage one object in the pool's store and get the
+        ref back: pass it (alone, or inside arg tuples) to any map/apply
+        and workers resolve it through the per-host cache. For payloads
+        the automatic threshold already catches this is redundant — it
+        exists for pinning very hot broadcasts across many maps without
+        re-probing, and for sub-threshold objects you still want
+        deduplicated. Held for the pool's lifetime (spilled, not
+        dropped, under memory pressure)."""
+        if self._objstore is None:
+            raise ValueError(
+                "object store is disabled (store_enabled=False or "
+                "store_inline_max=0)")
+        return self._objstore.put(obj, refs=1, owner=self._store_addr)
+
+    def store_stats(self) -> Dict[str, Any]:
+        """Operator counters for the by-reference plane (exposed next to
+        the backend's host_health): hit/miss/bytes from this process's
+        store server plus the pool's inline-fallback count."""
+        out: Dict[str, Any] = {
+            "enabled": self._objstore is not None,
+            "inline_fallbacks": self._store_fallbacks,
+        }
+        if self._store_server is not None:
+            out.update(self._store_server.stats())
+        return out
 
     # -- submission --------------------------------------------------------
     def _submit(
@@ -1111,8 +1411,22 @@ class Pool:
         with global_timer.section("pool.serialize"):
             blob = serialization.dumps(func)
             digest = hashlib.md5(blob).digest()
-            for base in range(0, len(items), chunksize):
-                chunk = items[base:base + chunksize]
+            enc_items = items
+            if self._objstore is not None and self._store_inline_max:
+                seq_digests: List[str] = []
+                try:
+                    enc_items = self._encode_items(items, seq_digests)
+                except Exception:  # noqa: BLE001 - optimization only
+                    logger.warning(
+                        "store: arg encoding failed; shipping inline",
+                        exc_info=True)
+                    enc_items = items
+                    seq_digests = []
+                if seq_digests:
+                    self._arm_store_fallback(seq, digest, blob, star,
+                                             items, seq_digests)
+            for base in range(0, len(enc_items), chunksize):
+                chunk = enc_items[base:base + chunksize]
                 payload = serialization.dumps(
                     ("task", seq, base, digest, blob, chunk, star)
                 )
@@ -1678,6 +1992,24 @@ class ResilientPool(Pool):
         # map must not pay an inbox put per result).
         if self._parked_count:
             # Narrow except: shutdown races only (see submit-side twin).
+            try:
+                self._task_ep.wake()
+            except (TransportClosed, OSError):
+                pass
+
+    def _on_store_miss(self, seq, base, n, ident) -> None:
+        """Resilient twist on the inline resend: the reporting worker's
+        pending entry for this chunk is retired first, so a later death
+        of that worker doesn't also resubmit the ref-bearing payload it
+        couldn't resolve (dedup would absorb it, but the doomed handout
+        would burn a fetch cycle). New chunks can clear parked
+        requests' reservation gates — nudge the handout loop."""
+        with self._pending_lock:
+            table = self._pending.get(ident)
+            if table is not None:
+                table.pop((seq, base), None)
+        super()._on_store_miss(seq, base, n, ident)
+        if self._parked_count:
             try:
                 self._task_ep.wake()
             except (TransportClosed, OSError):

@@ -1,0 +1,85 @@
+"""fiber_tpu.store — the per-host object store (by-reference data plane).
+
+Layer between L3 transport and the pool API: large task args/results are
+``put`` once into a content-addressed host store and travel as tiny
+:class:`ObjectRef` handles; workers resolve refs through a per-host
+cache. See docs/objectstore.md for lifecycle, thresholds and failure
+semantics.
+
+Process-wide singletons: one LocalStore (and at most one StoreServer /
+StoreClient) per process — "per-host" is the design point, so every
+pool and queue in a process shares the same store, and worker processes
+on one host share the on-disk cache tier under the staging root.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+from fiber_tpu.store.core import (  # noqa: F401
+    LocalStore,
+    ObjectRef,
+    default_store_root,
+    digest_of,
+)
+from fiber_tpu.store.plane import (  # noqa: F401
+    STORE_CHUNK,
+    StoreClient,
+    StoreFetchError,
+    StoreServer,
+)
+
+_lock = threading.Lock()
+_store: Optional[LocalStore] = None
+_server: Optional[StoreServer] = None
+_client: Optional[StoreClient] = None
+
+
+def local_store() -> LocalStore:
+    """The process-wide LocalStore, built from config on first use."""
+    global _store
+    with _lock:
+        if _store is None:
+            from fiber_tpu import config
+
+            cfg = config.get()
+            _store = LocalStore(
+                capacity_bytes=int(cfg.store_capacity_mb) << 20,
+                root=default_store_root(),
+            )
+        return _store
+
+
+def ensure_server(ip: str) -> Tuple[StoreServer, str]:
+    """The process-wide StoreServer (bound on first use); returns
+    ``(server, addr)``. Masters call this; workers only ever dial."""
+    global _server
+    store = local_store()
+    with _lock:
+        if _server is None:
+            _server = StoreServer(store, ip)
+        return _server, _server.addr
+
+
+def client() -> StoreClient:
+    """The process-wide StoreClient (resolution cache + owner conns)."""
+    global _client
+    store = local_store()
+    with _lock:
+        if _client is None:
+            _client = StoreClient(store)
+        return _client
+
+
+def reset(close: bool = True) -> None:
+    """Drop the singletons (tests: rebuild against fresh config)."""
+    global _store, _server, _client
+    with _lock:
+        store, server, cli = _store, _server, _client
+        _store = _server = _client = None
+    if close:
+        if server is not None:
+            server.close()
+        if cli is not None:
+            cli.close()

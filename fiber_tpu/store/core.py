@@ -1,0 +1,362 @@
+"""Content-addressed per-host object store: ObjectRef + LocalStore.
+
+The data-plane problem this solves (Moritz et al., 2018 — Ray's Plasma
+store — applied to the fiber workload): ES/POET masters broadcast one
+large immutable blob (policy parameters) to hundreds of tasks per
+generation, and a ship-by-value task protocol serializes and transmits
+it once *per task*. Here large payloads are ``put`` once, addressed by
+content digest, and every task carries a tiny :class:`ObjectRef`;
+workers resolve refs through a per-host cache so the payload crosses
+the wire once per host per generation (fiber_tpu/store/plane.py owns
+the wire; this module owns the host-local state).
+
+Storage model — one object is one opaque byte string, exactly what
+``serialization.loads`` accepts (the protocol-5 out-of-band envelope or
+a plain pickle), so disk files, wire chunks and RAM entries are all the
+same representation:
+
+* **RAM tier**: LRU over unpinned entries, capacity-bounded.
+* **Disk tier**: ``<root>/<digest>.obj`` under the staging root
+  (utils/staging.py) — doubles as the *host cache* shared by every
+  fiber process on the host (atomic rename publication) and as the
+  spill target for RAM evictions.
+* **Refs and pins**: ``refs`` is the lifecycle count (a map in flight
+  holds one ref on each of its arg objects; releases on completion make
+  the entry evictable). ``pins`` is a short-lived hard pin held across
+  a wire transfer so eviction can never free buffers mid-send. Entries
+  with refs or pins never leave the store entirely: capacity pressure
+  spills them to disk instead of dropping them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tempfile
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from fiber_tpu import serialization
+from fiber_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+#: Best-effort bound on the disk tier (spill + host cache), bytes.
+#: Enforced opportunistically at spill time, oldest files first.
+DEFAULT_MAX_DISK_BYTES = 4 << 30
+
+
+def default_store_root() -> str:
+    """``store_dir`` config, or ``<staging root>/objects`` (the same
+    root host agents confine file ops to, so agent-plane store ops and
+    worker-local caching see one directory)."""
+    from fiber_tpu import config
+
+    configured = str(config.get().store_dir or "")
+    if configured:
+        return os.path.realpath(configured)
+    from fiber_tpu.host_agent import default_staging_root
+
+    return os.path.join(os.path.realpath(default_staging_root()), "objects")
+
+
+class ObjectRef:
+    """By-reference handle to one stored payload: content ``digest``
+    (hex sha256), serialized ``size`` in bytes, and the ``owner`` store
+    address (``tcp://ip:port``) that is guaranteed to be able to serve
+    it. Tiny and picklable — this is what rides task/result frames."""
+
+    __slots__ = ("digest", "size", "owner")
+
+    def __init__(self, digest: str, size: int, owner: str = "") -> None:
+        self.digest = digest
+        self.size = int(size)
+        self.owner = owner
+
+    def __reduce__(self):
+        return (ObjectRef, (self.digest, self.size, self.owner))
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, ObjectRef)
+                and other.digest == self.digest
+                and other.owner == self.owner)
+
+    def __hash__(self) -> int:
+        return hash((self.digest, self.owner))
+
+    def __repr__(self) -> str:
+        return (f"ObjectRef({self.digest[:12]}…, size={self.size}, "
+                f"owner={self.owner!r})")
+
+
+def digest_of(data) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+class _Entry:
+    __slots__ = ("data", "refs", "pins", "on_disk")
+
+    def __init__(self, data: bytes, refs: int, on_disk: bool) -> None:
+        self.data = data
+        self.refs = refs
+        self.pins = 0
+        self.on_disk = on_disk
+
+
+class LocalStore:
+    """Host-RAM object store with LRU eviction and disk spill.
+
+    Thread-safe. ``root=None`` disables the disk tier entirely (unit
+    tests, memory-only caches); then entries with refs/pins are simply
+    never evicted.
+    """
+
+    def __init__(self, capacity_bytes: int = 512 << 20,
+                 root: Optional[str] = None,
+                 max_disk_bytes: int = DEFAULT_MAX_DISK_BYTES) -> None:
+        self.capacity_bytes = int(capacity_bytes)
+        self.root = os.path.realpath(root) if root else None
+        self.max_disk_bytes = int(max_disk_bytes)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self._ram_bytes = 0
+        self._stats: Dict[str, int] = {
+            "puts": 0, "put_dedup_hits": 0,
+            "ram_hits": 0, "disk_hits": 0, "misses": 0,
+            "evictions": 0, "spills": 0, "spill_bytes": 0,
+        }
+
+    # -- paths ----------------------------------------------------------
+    def _path(self, digest: str) -> str:
+        # digest is validated hex (never user-controlled path material).
+        return os.path.join(self.root, f"{digest}.obj")
+
+    # -- write side -----------------------------------------------------
+    def put(self, obj: Any, refs: int = 0,
+            owner: str = "") -> ObjectRef:
+        """Serialize ``obj`` (protocol-5 out-of-band envelope: large
+        numpy/jax buffers are gathered, not re-copied through the
+        pickler) and store it. Content-addressed: an identical payload
+        already present just gains ``refs``."""
+        data, buffers = serialization.dumps_oob(obj)
+        if buffers:
+            blob = serialization.pack_envelope(data, buffers)
+        else:
+            blob = data
+        return self.put_bytes(blob, refs=refs, owner=owner)
+
+    def put_bytes(self, data, refs: int = 0, owner: str = "",
+                  persist: bool = False,
+                  digest: Optional[str] = None) -> ObjectRef:
+        """Store one serialized payload. ``persist=True`` publishes it
+        to the host cache file immediately (fetched objects — sibling
+        processes on this host must be able to find them *now*, not at
+        spill time); master-side puts default to lazy (spill-only)."""
+        data = bytes(data)
+        digest = digest or digest_of(data)
+        with self._lock:
+            entry = self._entries.get(digest)
+            if entry is not None:
+                entry.refs += refs
+                self._entries.move_to_end(digest)
+                self._stats["put_dedup_hits"] += 1
+                return ObjectRef(digest, len(entry.data), owner)
+            on_disk = self.root is not None and os.path.exists(
+                self._path(digest))
+            self._entries[digest] = _Entry(data, refs, on_disk)
+            self._ram_bytes += len(data)
+            self._stats["puts"] += 1
+            self._evict_locked()
+        if persist and self.root is not None \
+                and self._write_disk(digest, data):
+            with self._lock:
+                e = self._entries.get(digest)
+                if e is not None:
+                    e.on_disk = True
+        return ObjectRef(digest, len(data), owner)
+
+    # -- read side ------------------------------------------------------
+    def get_bytes(self, digest: str, pin: bool = False) -> Optional[bytes]:
+        """RAM tier, then the disk tier; None on a true miss. With
+        ``pin=True`` the entry is hard-pinned (caller must
+        :meth:`unpin` after its transfer completes)."""
+        with self._lock:
+            entry = self._entries.get(digest)
+            if entry is not None:
+                self._entries.move_to_end(digest)
+                if pin:
+                    entry.pins += 1
+                self._stats["ram_hits"] += 1
+                return entry.data
+        data = self._read_disk(digest)
+        if data is None:
+            with self._lock:
+                self._stats["misses"] += 1
+            return None
+        with self._lock:
+            self._stats["disk_hits"] += 1
+            entry = self._entries.get(digest)
+            if entry is None:
+                entry = _Entry(data, 0, on_disk=True)
+                self._entries[digest] = entry
+                self._ram_bytes += len(data)
+                self._evict_locked(protect=digest)
+            if pin:
+                entry.pins += 1
+            return entry.data
+
+    def get(self, digest: str) -> Tuple[bool, Any]:
+        """Deserialized fetch: ``(found, obj)``."""
+        data = self.get_bytes(digest)
+        if data is None:
+            return False, None
+        return True, serialization.loads(data)
+
+    def contains(self, digest: str) -> bool:
+        with self._lock:
+            if digest in self._entries:
+                return True
+        return (self.root is not None
+                and os.path.exists(self._path(digest)))
+
+    # -- lifecycle ------------------------------------------------------
+    def add_ref(self, digest: str, n: int = 1) -> None:
+        with self._lock:
+            entry = self._entries.get(digest)
+            if entry is not None:
+                entry.refs += n
+
+    def release(self, digest: str, n: int = 1) -> None:
+        """Drop lifecycle refs; at zero the entry becomes an ordinary
+        LRU citizen (evicted under capacity pressure, droppable once
+        spilled)."""
+        with self._lock:
+            entry = self._entries.get(digest)
+            if entry is not None:
+                entry.refs = max(0, entry.refs - n)
+
+    def unpin(self, digest: str) -> None:
+        with self._lock:
+            entry = self._entries.get(digest)
+            if entry is not None:
+                entry.pins = max(0, entry.pins - 1)
+
+    def delete(self, digest: str) -> None:
+        """Drop an entry from RAM and disk regardless of refs (operator
+        tooling; in-flight transfers still hold their own `data`
+        reference, Python's GC makes this safe)."""
+        with self._lock:
+            entry = self._entries.pop(digest, None)
+            if entry is not None:
+                self._ram_bytes -= len(entry.data)
+        if self.root is not None:
+            try:
+                os.unlink(self._path(digest))
+            except OSError:
+                pass
+
+    # -- eviction / spill ----------------------------------------------
+    def _evict_locked(self, protect: Optional[str] = None) -> None:
+        """Walk the LRU order until under capacity (caller holds lock).
+        Pinned entries are untouchable; ref-held entries must survive
+        somewhere, so without a disk tier they are skipped too."""
+        if self._ram_bytes <= self.capacity_bytes:
+            return
+        for digest in list(self._entries):
+            if self._ram_bytes <= self.capacity_bytes:
+                return
+            entry = self._entries[digest]
+            if digest == protect or entry.pins > 0:
+                continue
+            if entry.refs > 0 and self.root is None:
+                continue  # nowhere to keep it; must stay resident
+            if self.root is not None and not entry.on_disk:
+                if not self._write_disk(digest, entry.data):
+                    if entry.refs > 0:
+                        continue  # spill failed; dropping would lose it
+                else:
+                    entry.on_disk = True
+                    self._stats["spills"] += 1
+                    self._stats["spill_bytes"] += len(entry.data)
+            del self._entries[digest]
+            self._ram_bytes -= len(entry.data)
+            self._stats["evictions"] += 1
+
+    def _write_disk(self, digest: str, data: bytes) -> bool:
+        """Atomic publication: tmp file + rename, so concurrent readers
+        (sibling processes on this host) only ever see complete
+        objects. False when the write failed (full/readonly disk)."""
+        path = self._path(digest)
+        if os.path.exists(path):
+            return True
+        try:
+            os.makedirs(self.root, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as fh:
+                    fh.write(data)
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        except OSError:
+            logger.warning("object store: disk write failed for %s "
+                           "(continuing RAM-only)", digest[:12],
+                           exc_info=True)
+            return False
+        self._trim_disk()
+        return True
+
+    def _read_disk(self, digest: str) -> Optional[bytes]:
+        if self.root is None:
+            return None
+        try:
+            with open(self._path(digest), "rb") as fh:
+                return fh.read()
+        except OSError:
+            return None
+
+    def _trim_disk(self) -> None:
+        """Keep the disk tier under max_disk_bytes, oldest-mtime first
+        (best effort — concurrent processes may race; losing a cache
+        file only costs a re-fetch)."""
+        try:
+            names = [n for n in os.listdir(self.root)
+                     if n.endswith(".obj")]
+            files = []
+            total = 0
+            for n in names:
+                p = os.path.join(self.root, n)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                files.append((st.st_mtime, st.st_size, p))
+                total += st.st_size
+            files.sort()
+            for _, size, p in files:
+                if total <= self.max_disk_bytes:
+                    break
+                try:
+                    os.unlink(p)
+                    total -= size
+                except OSError:
+                    pass
+        except OSError:
+            pass
+
+    # -- introspection --------------------------------------------------
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            out = dict(self._stats)
+            out["objects"] = len(self._entries)
+            out["ram_bytes"] = self._ram_bytes
+        return out
+
+    def ram_digests(self) -> List[str]:
+        with self._lock:
+            return list(self._entries)

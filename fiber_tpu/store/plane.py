@@ -1,0 +1,433 @@
+"""Store wire plane: chunked fetch/put RPC over the authenticated
+transport.
+
+The server is an ``Endpoint("rep")`` on the same framed-TCP,
+HMAC-authenticated plane as every other fiber_tpu listener (transport
+accept path runs fiber_tpu.auth per connection through the shared
+PreauthPool from utils/serve.py), so the store inherits the data plane's
+threat posture for free. Large objects stream as a header frame plus
+``STORE_CHUNK``-sized frames over framing.py instead of one giant frame
+— an 800 MB checkpoint never forces an 800 MB contiguous recv on either
+side of the transfer.
+
+Protocol (control frames are serialization.dumps tuples; chunk frames
+are raw bytes — the server tells them apart because chunks only ever
+follow a ``put`` header *from the same channel*, and a req client only
+ever sees chunks after an ``ok`` get header):
+
+===========================================  =============================
+client -> server                             server -> client
+===========================================  =============================
+("get", digest)                              ("ok", size, nchunks) + chunks
+                                             | ("miss",)
+("put", digest, size, nchunks) + chunks      ("ok",) | ("err", msg)
+("release", digest)                          ("ok",)
+("stats",)                                   ("ok", stats_dict)
+===========================================  =============================
+
+The client side (StoreClient) layers the per-host fetch discipline on
+top: RAM tier -> host cache file -> wire, with an O_EXCL lock file per
+digest so N worker processes on one host fetching the same broadcast
+object cost ONE wire transfer (the losers wait for the winner's atomic
+cache publication). All failures converge on :class:`StoreFetchError`;
+the pool turns that into its storemiss/inline fallback instead of
+failing tasks.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from fiber_tpu import serialization
+from fiber_tpu.store.core import LocalStore, ObjectRef, digest_of
+from fiber_tpu.testing import chaos
+from fiber_tpu.transport import Endpoint, TransportClosed
+from fiber_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+#: One wire chunk. Big enough to amortize framing, small enough that a
+#: slow peer never parks tens of MB in one socket write.
+STORE_CHUNK = 1 << 20
+
+#: How long a fetch loser waits for the lock winner's cache publication
+#: before giving up on dedup and fetching directly (correctness beats
+#: once-per-host when the winner crashed mid-fetch).
+LOCK_WAIT_S = 10.0
+
+_CONNECT_TIMEOUT = 30.0
+
+
+class StoreFetchError(RuntimeError):
+    """An ObjectRef could not be resolved (owner unreachable, object
+    evicted and unspilled, injected chaos). The pool's storemiss path
+    degrades the affected chunk to inline payloads."""
+
+
+class StoreServer:
+    """Serves one LocalStore on the transport plane. ``addr`` is what
+    goes into ObjectRef.owner."""
+
+    def __init__(self, store: LocalStore, ip: str) -> None:
+        self.store = store
+        self._ep = Endpoint("rep")
+        self.addr = self._ep.bind(ip)
+        self._stop = threading.Event()
+        # chan -> [digest, size, chunks_remaining, parts] for an
+        # in-flight chunked put (frames from one channel stay ordered;
+        # interleaving across channels is keyed apart here).
+        self._puts: Dict[Any, list] = {}
+        self._stats_lock = threading.Lock()
+        self._stats: Dict[str, int] = {
+            "gets": 0, "get_misses": 0, "bytes_served": 0,
+            "puts": 0, "bytes_received": 0, "errors": 0,
+        }
+        self._thread = threading.Thread(
+            target=self._serve_loop, name="fiber-store-serve", daemon=True
+        )
+        self._thread.start()
+
+    # -- lifecycle ------------------------------------------------------
+    def close(self) -> None:
+        self._stop.set()
+        self._ep.close()
+
+    def stats(self) -> Dict[str, int]:
+        with self._stats_lock:
+            out = dict(self._stats)
+        out.update({f"store_{k}": v for k, v in self.store.stats().items()})
+        return out
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self._stats[key] += n
+
+    # -- serve loop -----------------------------------------------------
+    def _serve_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                frame, chan = self._ep.recv_req(timeout=0.5)
+            except TimeoutError:
+                if self._puts:
+                    # A client that died mid-put must not leak its
+                    # half-assembled chunks forever.
+                    self._puts = {c: p for c, p in self._puts.items()
+                                  if c.alive}
+                continue
+            except (TransportClosed, OSError):
+                return
+            try:
+                self._handle(frame, chan)
+            except Exception:
+                self._bump("errors")
+                logger.exception("store server: dropping bad request")
+
+    def _handle(self, frame, chan) -> None:
+        pending = self._puts.get(chan)
+        if pending is not None:
+            self._absorb_put_chunk(pending, frame, chan)
+            return
+        msg = serialization.loads(frame)
+        op = msg[0]
+        if op == "get":
+            self._handle_get(msg[1], chan)
+        elif op == "put":
+            _, digest, size, nchunks = msg
+            if nchunks <= 0:
+                self._finish_put(chan, digest, b"")
+            else:
+                self._puts[chan] = [digest, int(size), int(nchunks), []]
+        elif op == "release":
+            self.store.release(msg[1])
+            self._reply(chan, ("ok",))
+        elif op == "stats":
+            self._reply(chan, ("ok", self.stats()))
+        else:
+            self._reply(chan, ("err", f"unknown store op {op!r}"))
+
+    def _reply(self, chan, msg: Tuple) -> None:
+        try:
+            Endpoint.reply(chan, serialization.dumps(msg))
+        except (TransportClosed, OSError):
+            pass  # requester gone; nothing to clean up
+
+    def _handle_get(self, digest: str, chan) -> None:
+        plan = chaos._plan
+        if plan is not None:
+            plan.maybe_slow_store()
+        data = self.store.get_bytes(digest, pin=True)
+        if data is None:
+            self._bump("get_misses")
+            self._reply(chan, ("miss",))
+            return
+        try:
+            view = memoryview(data)
+            nchunks = -(-len(data) // STORE_CHUNK) if data else 0
+            self._reply(chan, ("ok", len(data), nchunks))
+            try:
+                for off in range(0, len(data), STORE_CHUNK):
+                    chan.send(view[off:off + STORE_CHUNK])
+            except (TransportClosed, OSError):
+                return  # reader died mid-stream; pin still released
+            self._bump("gets")
+            self._bump("bytes_served", len(data))
+        finally:
+            self.store.unpin(digest)
+
+    def _absorb_put_chunk(self, pending, frame, chan) -> None:
+        digest, size, remaining, parts = pending
+        parts.append(bytes(frame))
+        pending[2] = remaining - 1
+        if pending[2] > 0:
+            return
+        del self._puts[chan]
+        self._finish_put(chan, digest, b"".join(parts))
+
+    def _finish_put(self, chan, digest: str, data: bytes) -> None:
+        # Verify the content address: a corrupted or malicious payload
+        # must not poison the cache under someone else's digest.
+        if digest_of(data) != digest:
+            self._bump("errors")
+            self._reply(chan, ("err", "digest mismatch"))
+            return
+        # refs=1: owned-until-claimed — the consumer that resolves the
+        # ref releases it (pool result path); a put that is never
+        # claimed stays spillable but resident-or-on-disk.
+        self.store.put_bytes(data, refs=1, digest=digest)
+        self._bump("puts")
+        self._bump("bytes_received", len(data))
+        self._reply(chan, ("ok",))
+
+
+class StoreClient:
+    """Resolve/push ObjectRefs against remote owners, through the local
+    store's RAM/disk tiers. One per process is enough (connections are
+    cached per owner address); the pool worker creates it lazily."""
+
+    def __init__(self, store: LocalStore,
+                 resolve_cache_entries: int = 16) -> None:
+        self.store = store
+        self._conns: Dict[str, Endpoint] = {}
+        self._conn_lock = threading.Lock()
+        # digest -> deserialized object. Resolution cache: a broadcast
+        # arg is deserialized (and jax.device_put) once per worker, not
+        # once per task. Resolved objects are therefore SHARED across
+        # tasks in this process — the store convention (same as Ray) is
+        # that stored payloads are immutable.
+        self._objs: Dict[str, Any] = {}
+        self._obj_order: list = []
+        self._obj_cap = int(resolve_cache_entries)
+        self._stats: Dict[str, int] = {
+            "resolves": 0, "obj_cache_hits": 0, "wire_fetches": 0,
+            "wire_bytes": 0, "lock_waits": 0, "fetch_failures": 0,
+        }
+
+    # -- resolution -----------------------------------------------------
+    def resolve(self, ref: ObjectRef) -> Any:
+        self._stats["resolves"] += 1
+        obj = self._objs.get(ref.digest)
+        if obj is not None or ref.digest in self._objs:
+            self._stats["obj_cache_hits"] += 1
+            return obj
+        data = self.fetch_bytes(ref)
+        obj = serialization.loads(data)
+        self._objs[ref.digest] = obj
+        self._obj_order.append(ref.digest)
+        while len(self._obj_order) > self._obj_cap:
+            self._objs.pop(self._obj_order.pop(0), None)
+        return obj
+
+    def fetch_bytes(self, ref: ObjectRef) -> bytes:
+        """Serialized payload for ``ref``: local tiers first, then the
+        owner over the wire (once per host — lock-file dedup against
+        sibling processes). Raises StoreFetchError when every source
+        fails."""
+        data = self.store.get_bytes(ref.digest)
+        if data is not None:
+            return data
+        if not ref.owner:
+            raise StoreFetchError(
+                f"object {ref.digest[:12]} not present locally and the "
+                "ref names no owner")
+        return self._fetch_wire_deduped(ref)
+
+    def _fetch_wire_deduped(self, ref: ObjectRef) -> bytes:
+        root = self.store.root
+        if root is None:
+            data = self._fetch_wire(ref)
+            self.store.put_bytes(data, digest=ref.digest)
+            return data
+        lock_path = os.path.join(root, f"{ref.digest}.fetch-lock")
+        try:
+            os.makedirs(root, exist_ok=True)
+            fd = os.open(lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+        except FileExistsError:
+            # A sibling process is already fetching this object; wait
+            # for its atomic cache publication instead of duplicating
+            # the transfer.
+            self._stats["lock_waits"] += 1
+            deadline = time.monotonic() + LOCK_WAIT_S
+            while time.monotonic() < deadline:
+                data = self.store.get_bytes(ref.digest)
+                if data is not None:
+                    return data
+                if not os.path.exists(lock_path):
+                    break  # winner finished (or died); check once more
+                time.sleep(0.01)
+            data = self.store.get_bytes(ref.digest)
+            if data is not None:
+                return data
+            # Winner crashed or is stuck: correctness over dedup.
+            data = self._fetch_wire(ref)
+            self.store.put_bytes(data, persist=True, digest=ref.digest)
+            return data
+        except OSError:
+            data = self._fetch_wire(ref)
+            self.store.put_bytes(data, digest=ref.digest)
+            return data
+        try:
+            data = self._fetch_wire(ref)
+            self.store.put_bytes(data, persist=True, digest=ref.digest)
+            return data
+        finally:
+            try:
+                os.unlink(lock_path)
+            except OSError:
+                pass
+
+    def _fetch_wire(self, ref: ObjectRef) -> bytes:
+        plan = chaos._plan
+        if plan is not None:
+            # Injected fetch failure (budgeted): models an unreachable
+            # or lying store; no retry — the pool's inline fallback is
+            # the behavior under test.
+            try:
+                plan.fail_point("store_fetch")
+            except chaos.ChaosError as err:
+                self._stats["fetch_failures"] += 1
+                raise StoreFetchError(str(err)) from err
+        last_err: Optional[BaseException] = None
+        for attempt in range(2):
+            try:
+                data = self._fetch_once(ref, fresh=attempt > 0)
+                self._stats["wire_fetches"] += 1
+                self._stats["wire_bytes"] += len(data)
+                return data
+            except StoreFetchError:
+                raise  # definitive (miss / digest mismatch): no retry
+            except (TransportClosed, OSError, TimeoutError) as err:
+                last_err = err
+                self._drop_conn(ref.owner)
+        self._stats["fetch_failures"] += 1
+        raise StoreFetchError(
+            f"fetch of {ref.digest[:12]} from {ref.owner} failed: "
+            f"{last_err!r}")
+
+    def _fetch_once(self, ref: ObjectRef, fresh: bool) -> bytes:
+        ep = self._conn(ref.owner, fresh=fresh)
+        ep.send(serialization.dumps(("get", ref.digest)),
+                timeout=_CONNECT_TIMEOUT)
+        head = serialization.loads(ep.recv(timeout=_CONNECT_TIMEOUT))
+        if head[0] == "miss":
+            self._stats["fetch_failures"] += 1
+            raise StoreFetchError(
+                f"owner {ref.owner} no longer holds {ref.digest[:12]}")
+        if head[0] != "ok":
+            self._stats["fetch_failures"] += 1
+            raise StoreFetchError(f"store get error: {head!r}")
+        _, size, nchunks = head
+        buf = bytearray(size)
+        off = 0
+        for _ in range(nchunks):
+            chunk = ep.recv(timeout=_CONNECT_TIMEOUT)
+            buf[off:off + len(chunk)] = chunk
+            off += len(chunk)
+        if off != size or digest_of(buf) != ref.digest:
+            raise StoreFetchError(
+                f"fetched object {ref.digest[:12]} failed verification")
+        return bytes(buf)
+
+    # -- push (worker results -> owner store) ---------------------------
+    def push(self, data: bytes, owner: str) -> ObjectRef:
+        """Upload one serialized payload to ``owner``'s store, chunked.
+        Raises on failure; callers fall back to inline shipping."""
+        digest = digest_of(data)
+        ep = self._conn(owner)
+        view = memoryview(data)
+        nchunks = -(-len(data) // STORE_CHUNK) if data else 0
+        try:
+            ep.send(serialization.dumps(("put", digest, len(data),
+                                         nchunks)),
+                    timeout=_CONNECT_TIMEOUT)
+            for off in range(0, len(data), STORE_CHUNK):
+                ep.send(view[off:off + STORE_CHUNK],
+                        timeout=_CONNECT_TIMEOUT)
+            reply = serialization.loads(ep.recv(timeout=_CONNECT_TIMEOUT))
+        except (TransportClosed, OSError, TimeoutError):
+            self._drop_conn(owner)
+            raise
+        if reply[0] != "ok":
+            raise RuntimeError(f"store put rejected: {reply!r}")
+        return ObjectRef(digest, len(data), owner)
+
+    def release(self, ref: ObjectRef) -> None:
+        """Best-effort remote ref release (lifecycle hint, never
+        load-bearing for correctness)."""
+        try:
+            ep = self._conn(ref.owner)
+            ep.send(serialization.dumps(("release", ref.digest)),
+                    timeout=5.0)
+            ep.recv(timeout=5.0)
+        except Exception:
+            pass
+
+    def owner_stats(self, owner: str) -> Dict[str, int]:
+        ep = self._conn(owner)
+        ep.send(serialization.dumps(("stats",)), timeout=_CONNECT_TIMEOUT)
+        reply = serialization.loads(ep.recv(timeout=_CONNECT_TIMEOUT))
+        if reply[0] != "ok":
+            raise RuntimeError(f"store stats failed: {reply!r}")
+        return reply[1]
+
+    def stats(self) -> Dict[str, int]:
+        return dict(self._stats)
+
+    # -- connections ----------------------------------------------------
+    def _conn(self, owner: str, fresh: bool = False) -> Endpoint:
+        with self._conn_lock:
+            ep = self._conns.get(owner)
+            if ep is not None and not fresh:
+                return ep
+            if ep is not None:
+                try:
+                    ep.close()
+                except Exception:
+                    pass
+            # Python Endpoint, not the native client: the store protocol
+            # interleaves control and raw chunk frames on one channel,
+            # which only the Python req path speaks.
+            ep = Endpoint("req").connect(owner, retries=1)
+            self._conns[owner] = ep
+            return ep
+
+    def _drop_conn(self, owner: str) -> None:
+        with self._conn_lock:
+            ep = self._conns.pop(owner, None)
+        if ep is not None:
+            try:
+                ep.close()
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        with self._conn_lock:
+            conns, self._conns = dict(self._conns), {}
+        for ep in conns.values():
+            try:
+                ep.close()
+            except Exception:
+                pass

@@ -39,6 +39,11 @@ fail_local_spawn      LocalBackend.create_job raises (budget) — spawn
                       failure burst at the backend boundary
 fail_launch           JobLauncher raises before create_job (budget)
 fail_agent_spawn      host agent's spawn op raises (budget)
+fail_store_fetch      object-store client's wire fetch raises (budget) —
+                      workers fall back to inline payloads via the
+                      pool's storemiss path instead of losing tasks
+slow_store_every/_s   object-store server serves every N-th get
+                      ``slow_store_s`` late — degraded-store latency
 stall_recv_after      one bound-``r`` ingress channel's reader sleeps
                       ``stall_recv_s`` seconds after its N-th data frame
                       (budget ``stall_recv_times``) — a silent network
@@ -68,16 +73,17 @@ ENV_VAR = "FIBER_CHAOS"
 CHAOS_EXIT_CODE = 44
 
 #: Budget-bearing fail points (``fail_<site>`` knobs / token kinds).
-FAIL_SITES = ("local_spawn", "launch", "agent_spawn")
+FAIL_SITES = ("local_spawn", "launch", "agent_spawn", "store_fetch")
 
 _INT_FIELDS = (
     "seed", "kill_after_chunks", "kill_times",
     "hang_after_chunks", "hang_times",
     "fail_local_spawn", "fail_launch", "fail_agent_spawn",
+    "fail_store_fetch", "slow_store_every",
     "stall_recv_after", "stall_recv_times",
     "drop_recv_every", "send_delay_every",
 )
-_FLOAT_FIELDS = ("hang_s", "stall_recv_s", "send_delay_s")
+_FLOAT_FIELDS = ("hang_s", "stall_recv_s", "send_delay_s", "slow_store_s")
 
 
 class ChaosError(RuntimeError):
@@ -95,6 +101,8 @@ class ChaosPlan:
                  hang_times: int = 1,
                  fail_local_spawn: int = 0, fail_launch: int = 0,
                  fail_agent_spawn: int = 0,
+                 fail_store_fetch: int = 0,
+                 slow_store_every: int = 0, slow_store_s: float = 0.0,
                  stall_recv_after: int = 0, stall_recv_s: float = 0.0,
                  stall_recv_times: int = 1,
                  drop_recv_every: int = 0,
@@ -111,6 +119,9 @@ class ChaosPlan:
         self.fail_local_spawn = int(fail_local_spawn)
         self.fail_launch = int(fail_launch)
         self.fail_agent_spawn = int(fail_agent_spawn)
+        self.fail_store_fetch = int(fail_store_fetch)
+        self.slow_store_every = int(slow_store_every)
+        self.slow_store_s = float(slow_store_s)
         self.stall_recv_after = int(stall_recv_after)
         self.stall_recv_s = float(stall_recv_s)
         self.stall_recv_times = int(stall_recv_times)
@@ -121,6 +132,7 @@ class ChaosPlan:
         self._lock = threading.Lock()
         self._hang_until = 0.0
         self._send_count = 0
+        self._store_gets = 0
 
     # -- spec (env) form ------------------------------------------------
     @classmethod
@@ -240,6 +252,18 @@ class ChaosPlan:
             delay = self._send_count % self.send_delay_every == 0
         if delay:
             time.sleep(self.send_delay_s)
+
+    def maybe_slow_store(self) -> None:
+        """Object-store server, per get: every N-th object is served
+        ``slow_store_s`` late — a saturated or degraded store the
+        by-reference data plane must absorb without failing tasks."""
+        if not self.slow_store_every:
+            return
+        with self._lock:
+            self._store_gets += 1
+            slow = self._store_gets % self.slow_store_every == 0
+        if slow:
+            time.sleep(self.slow_store_s)
 
 
 #: The active plan. Hook sites read this attribute directly — None means

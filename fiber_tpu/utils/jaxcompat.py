@@ -27,3 +27,15 @@ def shard_map(f, **kwargs):
         if ours in kwargs and ours not in _PARAMS and theirs in _PARAMS:
             kwargs[theirs] = kwargs.pop(ours)
     return _shard_map(f, **kwargs)
+
+
+def axis_size(axis_name):
+    """Size of a bound mesh axis, inside a collective context.
+    ``jax.lax.axis_size`` only exists on newer jax; the classic
+    spelling — ``psum(1, axis)``, constant-folded to the axis size —
+    works everywhere."""
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return int(jax.lax.axis_size(axis_name))
+    return int(jax.lax.psum(1, axis_name))

@@ -40,6 +40,23 @@ MAX_FILE_BYTES = 4 << 20
 
 _snapshot_cache: Optional[Tuple[str, List[Tuple[str, bytes, int]]]] = None
 
+#: The staging root also hosts the object store's disk tier
+#: (``<staging>/objects/<sha256>.obj`` — fiber_tpu/store): workspace
+#: snapshots and broadcast objects share one host-local, agent-servable
+#: directory, so every cluster data-distribution path is confined to
+#: the same root the agents police.
+OBJECTS_SUBDIR = "objects"
+
+_HEX = frozenset("0123456789abcdef")
+
+
+def is_object_digest(digest: str) -> bool:
+    """Valid store content address: 64 lowercase hex chars (sha256).
+    The digest becomes a file name under the staging root, so anything
+    else must be rejected before it touches a path."""
+    return (isinstance(digest, str) and len(digest) == 64
+            and set(digest) <= _HEX)
+
 
 def collect_workspace(
     root: Optional[str] = None,

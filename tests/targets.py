@@ -48,6 +48,27 @@ def write_config_value(path: str, key: str) -> None:
         fh.write(str(getattr(config.get(), key)))
 
 
+def arr_sum_plus(arr, i):
+    """Broadcast-style task: reduce a (possibly store-resolved) shared
+    array and mix in the per-task index."""
+    return float(arr.sum()) + i
+
+
+def arr_item(args):
+    """map-over-tuples variant of arr_sum_plus: one positional arg that
+    IS the (array, index) tuple."""
+    arr, i = args
+    return float(arr.sum()) + i
+
+
+def big_result(nbytes: int):
+    """Return a result large enough to travel by reference."""
+    import numpy as np
+
+    n = nbytes // 8
+    return np.arange(n, dtype=np.float64)
+
+
 def square(x: int) -> int:
     return x * x
 

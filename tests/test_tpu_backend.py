@@ -71,6 +71,29 @@ def test_file_staging(sim_backend, tmp_path):
     assert sim_backend.get_file(path) == b"cluster-wide data"
 
 
+def test_object_prestage_and_store_stats(sim_backend):
+    """The backend's object-cache surface (docs/objectstore.md):
+    put_object pushes one store payload into every host's cache tier
+    (content-addressed skip on repeat), store_stats reports each host
+    next to host_health."""
+    import os
+
+    from fiber_tpu import serialization
+    from fiber_tpu.store.core import digest_of
+
+    blob = serialization.dumps(os.urandom(300_000))
+    digest = digest_of(blob)
+    # Sim hosts share one filesystem, so the content-addressed skip
+    # already fires for the second host: >=1 pushed, not exactly 2.
+    assert sim_backend.put_object(digest, blob) >= 1
+    assert sim_backend.put_object(digest, blob) == 0  # already cached
+    stats = sim_backend.store_stats()
+    assert set(stats) == set(sim_backend.host_health())
+    for host_stats in stats.values():
+        assert host_stats["objects"] >= 1
+        assert host_stats["bytes"] >= len(blob)
+
+
 def test_full_stack_process_over_sim_cluster(monkeypatch, tmp_path):
     """fiber_tpu.Process + Pool running across the simulated pod hosts."""
     from fiber_tpu import config

@@ -3,7 +3,8 @@
 
 PYTEST ?= python -m pytest tests/ -q
 
-.PHONY: test stest test-all lint bench bench-store weakscale docs chaos
+.PHONY: test stest test-all lint bench bench-store bench-telemetry \
+	weakscale docs chaos
 
 # Tier 1: local backend (subprocess jobs)
 test:
@@ -46,6 +47,14 @@ bench:
 # files.
 bench-store:
 	JAX_PLATFORMS=cpu python bench.py --store | tee BENCH_store.json
+
+# Telemetry-plane overhead gate (docs/observability.md): small-task pool
+# throughput with telemetry off / metrics-only / full tracing; FAILS
+# when full-tracing overhead exceeds 5% on the microbench. The record
+# lands in BENCH_telemetry.json either way.
+bench-telemetry:
+	JAX_PLATFORMS=cpu python bench.py --telemetry > BENCH_telemetry.json; \
+	rc=$$?; cat BENCH_telemetry.json; exit $$rc
 
 # Weak-scaling record over 1/2/4/8-device sim meshes (fused ES,
 # population scaled with devices) + strong curve (constant total pop)

@@ -222,6 +222,58 @@ def _store_bench(args) -> int:
     return 0
 
 
+#: Max allowed full-tracing/telemetry-off wall ratio on the small-task
+#: pool microbench; `make bench-telemetry` fails past it.
+_TELEMETRY_BUDGET = 1.05
+
+
+def _telemetry_bench(args) -> int:
+    """Telemetry-plane overhead microbench (docs/observability.md):
+    pool throughput on the reference's signature small-task workload
+    with telemetry off / metrics-only / full tracing. Emits one JSON
+    line per mode plus a summary line; exits nonzero when full-tracing
+    overhead exceeds the 5% budget. Best-of-N walls so a CI scheduler
+    hiccup can't fail the gate."""
+    os.environ["FIBER_BACKEND"] = "local"
+    import fiber_tpu
+
+    n_tasks, duration, workers = 600, 0.001, 4
+    modes = (
+        ("off", dict(telemetry_enabled=False)),
+        ("metrics", dict(telemetry_enabled=True, trace_sample_rate=0.0)),
+        ("tracing", dict(telemetry_enabled=True, trace_sample_rate=1.0)),
+    )
+    walls = {}
+    for mode, overrides in modes:
+        fiber_tpu.init(worker_lite=True, **overrides)
+        best = None
+        for _ in range(int(args.telemetry_reps)):
+            with fiber_tpu.Pool(workers) as pool:
+                pool.map(_timed_task, [0.0] * workers)  # spin-up barrier
+                t0 = time.perf_counter()
+                pool.map(_timed_task, [duration] * n_tasks)
+                wall = time.perf_counter() - t0
+            best = wall if best is None else min(best, wall)
+        walls[mode] = best
+        _emit({"metric": f"pool_telemetry_{mode}_tasks_per_sec",
+               "value": round(n_tasks / best, 1), "unit": "tasks/s",
+               "tasks": n_tasks, "task_s": duration,
+               "wall_s": round(best, 4)})
+    fiber_tpu.init()
+    metrics_overhead = round(walls["metrics"] / walls["off"], 4)
+    tracing_overhead = round(walls["tracing"] / walls["off"], 4)
+    over = tracing_overhead > _TELEMETRY_BUDGET
+    _emit({"metric": "pool_telemetry_overhead",
+           "value": tracing_overhead, "unit": "x vs off",
+           "metrics_only_overhead": metrics_overhead,
+           "budget": _TELEMETRY_BUDGET, "over_budget": bool(over)})
+    if over:
+        print(f"FAIL: full-tracing overhead {tracing_overhead} exceeds "
+              f"budget {_TELEMETRY_BUDGET}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--platform", default="",
@@ -273,6 +325,15 @@ def main() -> int:
     parser.add_argument("--store-tasks", type=int, default=64,
                         help="task count for the --store broadcast "
                              "section")
+    parser.add_argument("--telemetry", action="store_true",
+                        help="bench the telemetry plane instead "
+                             "(docs/observability.md): small-task pool "
+                             "throughput with telemetry off / "
+                             "metrics-only / full tracing; fails past "
+                             "5% full-tracing overhead. Pure host "
+                             "plane (runs on JAX_PLATFORMS=cpu)")
+    parser.add_argument("--telemetry-reps", type=int, default=3,
+                        help="walls per mode for --telemetry (best-of)")
     parser.add_argument("--profile", default="",
                         help="write a jax.profiler trace of the timed ES "
                              "section to this directory (inspect with "
@@ -283,13 +344,15 @@ def main() -> int:
     if args.gens < 1:
         parser.error("--gens must be >= 1")
     if sum((args.poet, args.pixels, args.biped, args.attention,
-            args.lm, args.store)) > 1:
-        parser.error("--poet/--pixels/--biped/--attention/--lm/--store "
-                     "are mutually exclusive")
+            args.lm, args.store, args.telemetry)) > 1:
+        parser.error("--poet/--pixels/--biped/--attention/--lm/--store/"
+                     "--telemetry are mutually exclusive")
     if args.store:
         # Host-plane only: no accelerator probe, no watchdog — the
         # store bench must run identically on a laptop and a pod host.
         return _store_bench(args)
+    if args.telemetry:
+        return _telemetry_bench(args)  # host-plane only, like --store
     if args.pop is not None and args.pop < 2:
         parser.error("--pop must be >= 2")
     if args.steps is not None and args.steps < 1:

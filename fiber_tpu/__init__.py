@@ -49,9 +49,11 @@ def init(**kwargs):
     Reference parity: fiber/__init__.py:54-62 + fiber/init.py:52-73.
     """
     from fiber_tpu.utils import logging as _fl
+    from fiber_tpu import telemetry as _telemetry
 
     config.init(**kwargs)
     _fl.init_logger(config.get())
+    _telemetry.refresh()
 
 
 def reset():

@@ -82,6 +82,14 @@ class LocalBackend(Backend):
     def get_listen_addr(self) -> Tuple[str, int, str]:
         return ("127.0.0.1", 0, "lo")
 
+    def cluster_metrics(self) -> dict:
+        """Telemetry snapshot keyed like the tpu backend's per-host map
+        — the local backend's one 'host' is this process (same shape,
+        so tooling renders either backend identically)."""
+        from fiber_tpu import telemetry
+
+        return {"local": telemetry.snapshot()}
+
     def list_jobs(self) -> List[Job]:
         with self._lock:
             return [

@@ -514,6 +514,21 @@ class TpuBackend(Backend):
                 out[key] = {"error": repr(exc)}
         return out
 
+    # -- telemetry (docs/observability.md) -----------------------------
+    def cluster_metrics(self) -> Dict[str, dict]:
+        """Per-host telemetry snapshots keyed like :meth:`host_health` /
+        :meth:`store_stats` (one operator surface), via each agent's
+        ``telemetry_snapshot`` op. An unreachable host contributes an
+        ``error`` entry instead of failing the sweep."""
+        out: Dict[str, dict] = {}
+        for host in self._hosts:
+            key = f"{host[0]}:{host[1]}"
+            try:
+                out[key] = self._agent(host).call("telemetry_snapshot")
+            except Exception as exc:  # noqa: BLE001 - operator snapshot
+                out[key] = {"error": repr(exc)}
+        return out
+
 
 def make_backend() -> TpuBackend:
     return TpuBackend()

@@ -16,6 +16,8 @@ agent          run the per-host agent daemon (started on every TPU-VM)
 up             print (or execute) the commands that start agents on every
                host of a pod slice via gcloud ssh
 status         ping every host agent and report liveness/host info
+metrics        fetch every agent's telemetry snapshot (counters/timers;
+               --prom renders Prometheus v0.0.4 text exposition)
 logs           fetch a job's log tail by jid (host:port/jobid)
 cp             stage files to/from hosts through the agents
 =============  ==========================================================
@@ -592,6 +594,52 @@ def cmd_doctor(args) -> int:
     return rc
 
 
+def cmd_metrics(args) -> int:
+    """Fetch every host agent's telemetry snapshot and render it —
+    human-readable counters by default, ``--prom`` for Prometheus
+    v0.0.4 text exposition (host-labeled), ``--json`` for the raw
+    snapshots (docs/observability.md)."""
+    from fiber_tpu.backends.tpu import AgentClient
+
+    rc = 0
+    snaps = {}
+    for host, port in _resolve_cli_hosts(args):
+        key = f"{host}:{port}"
+        client = AgentClient(host, port)
+        try:
+            snaps[key] = client.call("telemetry_snapshot")
+        except Exception as err:  # noqa: BLE001
+            print(f"{key}  DOWN  ({err})", file=sys.stderr)
+            rc = 1
+        finally:
+            client.close()
+    if args.json:
+        print(json.dumps(snaps, indent=2, default=str))
+        return rc
+    if args.prom:
+        from fiber_tpu.telemetry import merge_snapshots
+        from fiber_tpu.telemetry.export import prometheus_text
+
+        merged = merge_snapshots(
+            {k: s.get("metrics", {}) for k, s in snaps.items()})
+        sys.stdout.write(prometheus_text(merged))
+        return rc
+    for key, snap in snaps.items():
+        print(f"{key}  pid={snap.get('pid')} "
+              f"enabled={snap.get('enabled')} "
+              f"spans_buffered={snap.get('spans_buffered')}")
+        for name, entry in sorted(snap.get("metrics", {}).items()):
+            for labels, value in sorted(entry.get("series", {}).items()):
+                if entry.get("type") == "histogram":
+                    value = (f"count={value[-1]} "
+                             f"sum={round(float(value[-2]), 6)}")
+                rendered = f"{{{labels}}}" if labels else ""
+                print(f"  {name}{rendered} {value}")
+        for section, stat in sorted(snap.get("timers", {}).items()):
+            print(f"  timer {section} count={stat[0]} total_s={stat[1]}")
+    return rc
+
+
 def cmd_logs(args) -> int:
     """Fetch a job's log tail by its jid (``host:port/jid`` — as printed
     by ``run --submit`` and carried by ``Process.job.jid``)."""
@@ -720,6 +768,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="port for portless --hosts entries / derived "
                         "addresses")
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("metrics",
+                       help="fetch and render every host agent's "
+                            "telemetry snapshot")
+    p.add_argument("--hosts", default="")
+    p.add_argument("--tpu", default="",
+                   help="TPU name: derive worker addresses via gcloud "
+                        "describe when --hosts is absent")
+    p.add_argument("--zone", default="")
+    p.add_argument("--port", type=int, default=0,
+                   help="port for portless --hosts entries / derived "
+                        "addresses")
+    p.add_argument("--prom", action="store_true",
+                   help="render as Prometheus v0.0.4 text exposition "
+                        "(host-labeled)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw per-host snapshots as JSON")
+    p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser("doctor",
                        help="diagnose the environment and cluster")

@@ -75,6 +75,22 @@ DEFAULTS: Dict[str, Any] = {
     # Strip accelerator runtime preloads from spawned host workers (faster
     # interpreter boot; only for workers that never touch the device).
     "worker_lite": False,
+    # --- telemetry plane (docs/observability.md) ---
+    # Master switch for the metrics registry + span tracing. Off, every
+    # instrument call is a single attribute check and nothing is
+    # recorded.
+    "telemetry_enabled": True,
+    # Fraction of Pool maps that get a trace id stamped into their task
+    # envelopes (workers then record + ship spans for those chunks).
+    # 1.0 = trace everything (default; the bench pins full-tracing
+    # overhead < 5% on the small-task microbench), 0.0 = metrics only.
+    "trace_sample_rate": 1.0,
+    # Per-process finished-span ring buffer: oldest spans fall out past
+    # this many (bounds memory on long-lived masters/workers).
+    "span_buffer_size": 4096,
+    # Port for the authenticated Prometheus exposition endpoint
+    # (telemetry.serve_metrics / the host agent's sidecar). 0 = off.
+    "metrics_port": 0,
     # --- TPU backend ---
     "tpu_name": "",
     "tpu_zone": "",

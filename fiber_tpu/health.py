@@ -34,9 +34,24 @@ import threading
 import time
 from typing import Callable, Dict, Iterable, Optional
 
+from fiber_tpu import telemetry
 from fiber_tpu.utils.logging import get_logger
 
 logger = get_logger()
+
+# Health-plane observability (docs/observability.md): breaker/suspect
+# state changes are exported metrics, not just log lines.
+_m_heartbeats = telemetry.counter(
+    "health_heartbeats_emitted", "Heartbeats emitted by this process")
+_m_suspects = telemetry.counter(
+    "health_suspects_declared",
+    "Peers declared dead by the deadline failure detector")
+_m_revived = telemetry.counter(
+    "health_peers_revived", "Suspected peers revived by a later beat")
+_m_breaker_opens = telemetry.counter(
+    "health_breaker_opens", "Circuit-breaker open transitions")
+_g_breaker_open = telemetry.gauge(
+    "health_breaker_open_keys", "Keys currently held open by a breaker")
 
 
 class Heartbeater:
@@ -79,6 +94,7 @@ class Heartbeater:
             try:
                 self._emit()
                 self.beats += 1
+                _m_heartbeats.inc()
             except TimeoutError:
                 continue  # congested; data frames in flight beat for us
             except OSError:
@@ -137,6 +153,7 @@ class FailureDetector:
                 revived = True
             self._last_seen[peer] = now
         if revived:
+            _m_revived.inc()
             logger.info("health: peer %r revived after being declared "
                         "dead", peer)
 
@@ -168,6 +185,7 @@ class FailureDetector:
                     del self._last_seen[peer]
                     self._dead.add(peer)
                     self.suspected_total += 1
+                    _m_suspects.inc()
             for peer in expired:
                 try:
                     self._on_suspect(peer)
@@ -231,15 +249,24 @@ class CircuitBreaker:
                 return False
             entry[1] += 1
             self.opened_total += 1
+            _m_breaker_opens.inc()
             backoff = min(self._base * (2 ** (entry[1] - 1)), self._max)
             backoff *= 1.0 + self._jitter * self._rng.random()
             entry[2] = time.monotonic() + backoff
             entry[0] = 0  # streak restarts toward the next open
+            now = time.monotonic()
+            _g_breaker_open.set(sum(
+                1 for e in self._state.values()
+                if e[2] is not None and now < e[2]))
             return True
 
     def record_success(self, key) -> None:
         with self._lock:
             self._state.pop(key, None)
+            now = time.monotonic()
+            _g_breaker_open.set(sum(
+                1 for e in self._state.values()
+                if e[2] is not None and now < e[2]))
 
     def state(self, key) -> str:
         with self._lock:

@@ -171,6 +171,10 @@ class HostAgent:
         handler = getattr(self, "_op_" + op, None)
         if handler is None:
             raise ValueError(f"unknown agent op {op!r}")
+        from fiber_tpu import telemetry
+
+        telemetry.counter(
+            "agent_ops", "Host-agent RPC ops served, by op").inc(op=op)
         return handler(*args)
 
     def _op_ping(self) -> str:
@@ -450,6 +454,15 @@ class HostAgent:
             pass
         return {"objects": count, "bytes": total}
 
+    # -- telemetry (docs/observability.md) ------------------------------
+    def _op_telemetry_snapshot(self) -> dict:
+        """This agent process's metrics/timers/span-buffer state — the
+        per-host payload ``TpuBackend.cluster_metrics`` and the
+        ``fiber-tpu metrics`` CLI aggregate."""
+        from fiber_tpu import telemetry
+
+        return telemetry.snapshot()
+
     def _op_host_info(self) -> dict:
         return {
             "pid": os.getpid(),
@@ -504,6 +517,22 @@ def main(argv=None) -> int:
                       cores=args.cores)
     if args.announce:
         print(f"AGENT_PORT {agent.port}", flush=True)
+    # Prometheus sidecar (docs/observability.md): an authenticated
+    # exposition endpoint next to the agent when metrics_port is set.
+    from fiber_tpu import config as fconfig
+
+    metrics_port = int(fconfig.get().metrics_port or 0)
+    if metrics_port > 0:
+        from fiber_tpu import telemetry
+
+        try:
+            server = telemetry.serve_metrics(metrics_port, bind=args.bind)
+            print(f"METRICS_PORT {server.port}", flush=True)
+        except Exception:
+            from fiber_tpu.utils.logging import get_logger
+
+            get_logger().exception("agent: metrics endpoint failed to "
+                                   "start; serving without it")
     # Die with the parent where supported (sim clusters).
     signal.signal(signal.SIGTERM, lambda *_: os._exit(0))
     agent.serve_forever()

@@ -26,7 +26,7 @@ import time
 from typing import Any, Dict, Optional
 
 from fiber_tpu import config
-from fiber_tpu import serialization
+from fiber_tpu import serialization, telemetry
 from fiber_tpu.admin import AdminServer, send_ident
 from fiber_tpu.backends import get_backend
 from fiber_tpu.core import Job, JobSpec, ProcessStatus
@@ -74,6 +74,7 @@ class JobLauncher:
 
     # ------------------------------------------------------------------
     def _launch(self, process_obj) -> None:
+        t_spawn = time.monotonic()
         cfg = config.get()
         ip, _, _ = self.backend.get_listen_addr()
         ident = next_launch_ident()
@@ -122,6 +123,17 @@ class JobLauncher:
         except Exception:
             self.backend.terminate_job(self.job)
             raise
+
+        # Spawn latency = job creation through worker connect-back (the
+        # whole interpreter-boot + handshake critical path a first map
+        # pays per worker).
+        telemetry.histogram(
+            "launch_spawn_seconds",
+            "Process launch latency: create_job to admin connect-back",
+        ).observe(time.monotonic() - t_spawn)
+        telemetry.counter(
+            "launch_spawns", "Processes launched through JobLauncher",
+        ).inc()
 
         # Stamp the pseudo-pid before pickling so the worker's
         # current_process().pid matches what the master sees.

@@ -24,6 +24,7 @@ of the host worker path.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import itertools
 from collections import deque
@@ -36,15 +37,40 @@ import traceback
 import uuid
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
-from fiber_tpu import serialization
+from fiber_tpu import serialization, telemetry
 from fiber_tpu.meta import get_meta
 from fiber_tpu.store.core import ObjectRef
 from fiber_tpu.store.plane import StoreFetchError
+from fiber_tpu.telemetry import tracing
 from fiber_tpu.testing import chaos
 from fiber_tpu.transport import Endpoint, TransportClosed
 from fiber_tpu.utils.logging import get_logger
+from fiber_tpu.utils.profiling import global_timer
 
 logger = get_logger()
+
+# Pool task-loop metrics (docs/observability.md). Registry instruments
+# are process-global; per-Pool exact counts live on the Pool instance
+# (Pool.stats()) so tests and operators can attribute them.
+_m_tasks_submitted = telemetry.counter(
+    "pool_tasks_submitted", "Task items submitted to host pools")
+_m_tasks_completed = telemetry.counter(
+    "pool_tasks_completed", "Task results received from workers")
+_m_chunks_dispatched = telemetry.counter(
+    "pool_chunks_dispatched", "Task chunks handed to workers")
+_m_chunks_resubmitted = telemetry.counter(
+    "pool_chunks_resubmitted",
+    "Chunks requeued after worker death or suspect declaration")
+_m_backpressure_waits = telemetry.counter(
+    "pool_backpressure_waits",
+    "Dispatches that blocked on the MAX_INFLIGHT_TASKS gate")
+_m_store_fallbacks = telemetry.counter(
+    "pool_store_inline_fallbacks",
+    "Chunks resent inline after a worker store-fetch failure")
+_g_queue_depth = telemetry.gauge(
+    "pool_queue_depth", "Chunks queued for dispatch")
+_g_inflight = telemetry.gauge(
+    "pool_inflight_tasks", "Task items submitted but not yet completed")
 
 DEFAULT_CHUNKSIZE = 32
 MAX_INFLIGHT_TASKS = 20000
@@ -325,7 +351,8 @@ class AsyncResult:
     def _fetch(self, timeout: Optional[float]) -> None:
         with self._fetch_lock:
             if self._value is _UNSET:
-                self._value = self._store.wait(self._seq, timeout)
+                with global_timer.section("pool.result_wait"):
+                    self._value = self._store.wait(self._seq, timeout)
 
     def get(self, timeout: Optional[float] = None) -> Any:
         self._fetch(timeout)
@@ -838,7 +865,35 @@ def _pool_worker_core(
             if msg[0] == "exit":
                 reason = "exit"
                 break
-            _, seq, base, digest, blob, chunk, star = msg
+            # 7-tuple envelopes predate the telemetry plane; the trace
+            # context rides as an optional 8th field so replayed/stored
+            # payloads of either shape decode.
+            seq, base, digest, blob, chunk, star = msg[1:7]
+            tctx = msg[7] if len(msg) > 7 else None
+
+            def _wspan(name: str, **attrs):
+                # Spans only for traced chunks (the master sampled this
+                # map): an unsampled map must not fill the ring buffer
+                # with spans nobody will ship.
+                if tctx is None:
+                    return contextlib.nullcontext()
+                return tracing.span(name, seq=seq, base=base, **attrs)
+
+            def _ship_spans() -> None:
+                if tctx is None:
+                    return
+                finished = tracing.SPANS.drain()
+                if not finished:
+                    return
+                try:
+                    # Spans ride the existing result stream (like the
+                    # health plane's heartbeats) — no extra sockets; a
+                    # lost spans frame costs observability, never
+                    # results.
+                    result_ep.send(serialization.dumps(
+                        ("spans", ident, finished)))
+                except (TransportClosed, OSError):
+                    pass
             plan = chaos._plan
             if plan is not None:
                 # Hang BEFORE compute (the held chunk is what the
@@ -846,38 +901,56 @@ def _pool_worker_core(
                 # (so the death strands staged/queued chunks, the
                 # resubmission case worth inducing).
                 plan.maybe_hang_worker(completed_chunks)
-            if _chunk_has_refs(chunk):
-                try:
-                    client = get_store_client()
-                    chunk = [_resolve_item(it, client) for it in chunk]
-                except StoreFetchError as err:
-                    # Degrade, don't fail: ask the master to resend
-                    # this chunk with inline payloads (the store is an
-                    # optimization, never a correctness dependency).
-                    logger.warning(
-                        "store: fetch failed (%s); requesting inline "
-                        "resend of chunk seq=%s base=%s", err, seq, base)
-                    result_ep.send(serialization.dumps(
-                        ("storemiss", seq, base, len(chunk), ident)))
-                    # The handout is consumed even though nothing ran:
-                    # the resilient fetch thread budgets FETCHED chunks
-                    # (maxtasksperchild), so skipping this increment
-                    # would leave the main loop waiting on a chunk the
-                    # fetcher will never deliver.
-                    completed_chunks += 1
-                    if maxtasksperchild \
-                            and completed_chunks >= maxtasksperchild:
-                        reason = "recycle"
-                        break
-                    continue
-            fn = funcs.get(digest, blob)
-            values = _run_chunk(fn, chunk, star)
-            if store_inline_max > 0:
-                values = _encode_results(values, get_store_client,
-                                         store_addr, store_inline_max)
+            with contextlib.ExitStack() as tstack:
+                if tctx is not None:
+                    # Adopt the master's trace so every span below
+                    # shares its trace id, parented on the map's
+                    # serialize span.
+                    tstack.enter_context(
+                        tracing.trace_context(tctx[0], tctx[1]))
+                if _chunk_has_refs(chunk):
+                    try:
+                        with _wspan("worker.resolve_refs"), \
+                                global_timer.section("pool.store_resolve"):
+                            client = get_store_client()
+                            chunk = [_resolve_item(it, client)
+                                     for it in chunk]
+                    except StoreFetchError as err:
+                        # Degrade, don't fail: ask the master to resend
+                        # this chunk with inline payloads (the store is
+                        # an optimization, never a correctness
+                        # dependency).
+                        logger.warning(
+                            "store: fetch failed (%s); requesting inline "
+                            "resend of chunk seq=%s base=%s",
+                            err, seq, base)
+                        result_ep.send(serialization.dumps(
+                            ("storemiss", seq, base, len(chunk), ident)))
+                        _ship_spans()
+                        # The handout is consumed even though nothing
+                        # ran: the resilient fetch thread budgets
+                        # FETCHED chunks (maxtasksperchild), so skipping
+                        # this increment would leave the main loop
+                        # waiting on a chunk the fetcher will never
+                        # deliver.
+                        completed_chunks += 1
+                        if maxtasksperchild \
+                                and completed_chunks >= maxtasksperchild:
+                            reason = "recycle"
+                            break
+                        continue
+                fn = funcs.get(digest, blob)
+                with _wspan("worker.execute", items=len(chunk)):
+                    values = _run_chunk(fn, chunk, star)
+                if store_inline_max > 0:
+                    with _wspan("worker.encode_results"):
+                        values = _encode_results(values, get_store_client,
+                                                 store_addr,
+                                                 store_inline_max)
             result_ep.send(
                 serialization.dumps(("result", seq, base, values, ident))
             )
+            _ship_spans()
             completed_chunks += 1
             if plan is not None:
                 plan.maybe_kill_worker(completed_chunks)
@@ -915,6 +988,14 @@ class Pool:
         from fiber_tpu.backends import get_backend
 
         cfg = config.get()
+        # Config may have changed since import (fiber_tpu.init); the
+        # telemetry plane follows the pool's view of it.
+        telemetry.refresh()
+        #: Per-pool exact counts surfaced by Pool.stats() (the registry
+        #: twins aggregate across every pool in the process).
+        self._n_submitted = 0
+        self._n_completed = 0
+        self._n_resubmitted = 0
         if processes is None:
             processes = get_backend().default_pool_size()
         if processes < 1:
@@ -1183,21 +1264,33 @@ class Pool:
         flow control (reference hot loop: fiber/pool.py:952-963)."""
         while True:
             item = self._taskq.get()
+            _g_queue_depth.set(self._taskq.qsize())
             if item is None:
                 return
             payload, _key = item
             # Backpressure waits on the store's condition (woken by
             # every completion) instead of a 10ms poll; the timeout
             # only bounds how long a terminate() can go unnoticed.
+            waited = False
             while not self._store.wait_outstanding_below(
                     MAX_INFLIGHT_TASKS, timeout=0.5):
+                waited = True
                 if self._terminated:
                     return
+            if waited:
+                _m_backpressure_waits.inc()
             while True:
                 if self._terminated:
                     return
                 try:
+                    t0 = time.perf_counter()
                     self._task_ep.send(payload, timeout=1.0)
+                    # add(), not section(): a timed-out send retry is a
+                    # wait for peers, not dispatch cost — only the
+                    # successful handout is recorded.
+                    global_timer.add("pool.dispatch",
+                                     time.perf_counter() - t0)
+                    _m_chunks_dispatched.inc()
                     break
                 except TimeoutError:
                     continue
@@ -1213,11 +1306,21 @@ class Pool:
             # A malformed frame must not kill the loop — that silently
             # hangs every outstanding .get() (advisor, round 1).
             try:
-                msg = serialization.loads(data)
+                with global_timer.section("pool.deserialize"):
+                    msg = serialization.loads(data)
                 detector = self._detector
                 if msg[0] == "hb":
                     if detector is not None:
                         detector.beat(msg[1])
+                    continue
+                if msg[0] == "spans":
+                    # Worker-side trace spans riding the result stream
+                    # (same transport posture as heartbeats): fold them
+                    # into the master's ring buffer, where trace_dump
+                    # assembles the cluster-wide timeline.
+                    if detector is not None:
+                        detector.beat(msg[1])
+                    tracing.SPANS.add_all(msg[2])
                     continue
                 if msg[0] == "storemiss":
                     _, seq, base, n, ident = msg
@@ -1235,9 +1338,13 @@ class Pool:
                     # read as death.
                     detector.beat(ident)
                 if any(isinstance(v, ObjectRef) for v in values):
-                    values = self._resolve_result_refs(values)
+                    with global_timer.section("pool.store_resolve"):
+                        values = self._resolve_result_refs(values)
+                self._n_completed += len(values)
+                _m_tasks_completed.inc(len(values))
                 self._on_result(seq, base, values, ident)
                 self._store.fill(seq, base, values)
+                _g_inflight.set(self._store.outstanding())
             except Exception:
                 logger.exception("pool: dropping malformed result frame")
 
@@ -1287,12 +1394,12 @@ class Pool:
         return ref
 
     def _arm_store_fallback(self, seq, digest, blob, star, items,
-                            seq_digests) -> None:
+                            seq_digests, tctx) -> None:
         """Keep enough context to resend any chunk inline (storemiss),
         and release the map's store refs when it completes (success,
         failure or abort — completion callbacks fire on all three)."""
         with self._seq_ctx_lock:
-            self._seq_ctx[seq] = (digest, blob, star, items)
+            self._seq_ctx[seq] = (digest, blob, star, items, tctx)
 
         def _cleanup() -> None:
             with self._seq_ctx_lock:
@@ -1311,12 +1418,15 @@ class Pool:
             ctx = self._seq_ctx.get(seq)
         if ctx is None or self._store.is_done(seq):
             return
-        fdigest, blob, star, items = ctx
+        fdigest, blob, star, items, tctx = ctx
         chunk = items[base:base + n]
+        # Same trace context as the original handout: the inline resend
+        # is one more hop of the same logical task, not a new trace.
         payload = serialization.dumps(
-            ("task", seq, base, fdigest, blob, chunk, star)
+            ("task", seq, base, fdigest, blob, chunk, star, tctx)
         )
         self._store_fallbacks += 1
+        _m_store_fallbacks.inc()
         logger.warning(
             "store: worker %s could not resolve refs (seq=%d base=%d); "
             "resending chunk inline", ident.hex()[:8], seq, base)
@@ -1376,6 +1486,42 @@ class Pool:
             out.update(self._store_server.stats())
         return out
 
+    # -- telemetry (docs/observability.md) ---------------------------------
+    def stats(self) -> Dict[str, Any]:
+        """Aggregated pool introspection: the global_timer's ``pool.*``
+        sections (count, total_s, mean_s) plus this pool's exact task
+        counters — the one timing surface (the same sections also land
+        in the registry's ``timer_seconds`` histogram)."""
+        return {
+            "timers": {name: stat for name, stat
+                       in global_timer.stats().items()
+                       if name.startswith("pool.")},
+            "tasks_submitted": self._n_submitted,
+            "tasks_completed": self._n_completed,
+            "chunks_resubmitted": self._n_resubmitted,
+            "store_fallbacks": self._store_fallbacks,
+            "queue_depth": self._taskq.qsize(),
+            "outstanding": self._store.outstanding(),
+            "workers": len(self._workers),
+        }
+
+    def metrics(self) -> Dict[str, dict]:
+        """Snapshot of the process metrics registry (every plane's
+        counters, not just this pool's) — the master-side sibling of the
+        host agent's ``telemetry_snapshot`` op."""
+        _g_queue_depth.set(self._taskq.qsize())
+        _g_inflight.set(self._store.outstanding())
+        return telemetry.REGISTRY.snapshot()
+
+    def trace_dump(self, path: str) -> str:
+        """Write the process span store — master spans plus every worker
+        span shipped back on the result stream — as Chrome trace-event
+        JSON loadable in Perfetto / chrome://tracing (pid = host,
+        tid = worker pid). Returns ``path``."""
+        from fiber_tpu.telemetry import export
+
+        return export.write_chrome_trace(path, tracing.SPANS.snapshot())
+
     # -- submission --------------------------------------------------------
     def _submit(
         self,
@@ -1406,16 +1552,26 @@ class Pool:
             # (reference fixed chunk: fiber/pool.py:1169-1170).
             chunksize = max(1, min(DEFAULT_CHUNKSIZE,
                                    -(-len(items) // (self._n_workers * 4))))
-        from fiber_tpu.utils.profiling import global_timer
-
-        with global_timer.section("pool.serialize"):
+        self._n_submitted += len(items)
+        _m_tasks_submitted.inc(len(items))
+        # One trace per sampled map: its id + the serialize span's id
+        # ride every task envelope so worker spans join the same trace
+        # (docs/observability.md). Unsampled maps ship tctx=None and the
+        # workers record nothing.
+        trace_id = telemetry.maybe_start_trace()
+        root_span = (tracing.span("pool.serialize", trace=trace_id,
+                                  seq=seq, items=len(items))
+                     if trace_id else contextlib.nullcontext())
+        with global_timer.section("pool.serialize"), root_span as sp:
+            tctx = (trace_id, sp["span"]) if sp is not None else None
             blob = serialization.dumps(func)
             digest = hashlib.md5(blob).digest()
             enc_items = items
             if self._objstore is not None and self._store_inline_max:
                 seq_digests: List[str] = []
                 try:
-                    enc_items = self._encode_items(items, seq_digests)
+                    with global_timer.section("pool.store_encode"):
+                        enc_items = self._encode_items(items, seq_digests)
                 except Exception:  # noqa: BLE001 - optimization only
                     logger.warning(
                         "store: arg encoding failed; shipping inline",
@@ -1424,13 +1580,14 @@ class Pool:
                     seq_digests = []
                 if seq_digests:
                     self._arm_store_fallback(seq, digest, blob, star,
-                                             items, seq_digests)
+                                             items, seq_digests, tctx)
             for base in range(0, len(enc_items), chunksize):
                 chunk = enc_items[base:base + chunksize]
                 payload = serialization.dumps(
-                    ("task", seq, base, digest, blob, chunk, star)
+                    ("task", seq, base, digest, blob, chunk, star, tctx)
                 )
                 self._taskq.put((payload, (seq, base)))
+        _g_queue_depth.set(self._taskq.qsize())
         if self._resilient and getattr(self, "_parked_count", 0):
             # New chunks can clear parked requests' reservation gates.
             # Narrow except: only shutdown races are benign — wake()'s
@@ -1515,12 +1672,18 @@ class Pool:
         if not items:
             return result
 
+        trace_id = telemetry.maybe_start_trace()
+
         def run() -> None:
-            try:
-                out = list(self._run_device(func, items, star))
-            except Exception as err:  # noqa: BLE001
-                store.fail(seq, err, reason="device dispatch failed")
-                return
+            dev_span = (tracing.span("pool.device_dispatch",
+                                     trace=trace_id, items=len(items))
+                        if trace_id else contextlib.nullcontext())
+            with dev_span:
+                try:
+                    out = list(self._run_device(func, items, star))
+                except Exception as err:  # noqa: BLE001
+                    store.fail(seq, err, reason="device dispatch failed")
+                    return
             store.fill(seq, 0, out)
 
         threading.Thread(target=run, name="fiber-device-dispatch",
@@ -1908,13 +2071,22 @@ class ResilientPool(Pool):
                     return
                 self._pending.setdefault(ident, {})[key] = payload
             try:
+                t0 = time.perf_counter()
                 self._task_ep.reply(chan, payload)
+                global_timer.add("pool.dispatch",
+                                 time.perf_counter() - t0)
+                _m_chunks_dispatched.inc()
+                _g_queue_depth.set(self._taskq.qsize())
             except (TransportClosed, OSError):
                 # Requester died between asking and receiving; put the
                 # chunk back for the next "ready" and keep serving.
+                # Counted as a resubmission: same cause (worker death),
+                # different observation path than the pending reclaim.
                 with self._pending_lock:
                     self._pending.get(ident, {}).pop(key, None)
                 self._taskq.put(item)
+                self._n_resubmitted += 1
+                _m_chunks_resubmitted.inc()
 
         while True:
             # Re-evaluate parked requests first: results arriving or
@@ -2086,6 +2258,9 @@ class ResilientPool(Pool):
                 continue  # e.g. failed by this call's poison path
             self._taskq.put((payload, key))
             requeued += 1
+        if requeued:
+            self._n_resubmitted += requeued
+            _m_chunks_resubmitted.inc(requeued)
         return requeued
 
     def _on_subworker_death(self, ident: bytes) -> None:

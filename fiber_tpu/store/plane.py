@@ -41,13 +41,22 @@ import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
-from fiber_tpu import serialization
+from fiber_tpu import serialization, telemetry
 from fiber_tpu.store.core import LocalStore, ObjectRef, digest_of
 from fiber_tpu.testing import chaos
 from fiber_tpu.transport import Endpoint, TransportClosed
 from fiber_tpu.utils.logging import get_logger
 
 logger = get_logger()
+
+# Store-plane metrics (docs/observability.md): the same counters the
+# ad-hoc ``store_stats`` dicts expose, mirrored into the shared registry
+# so cluster_metrics / the Prometheus endpoint see them. The "side"
+# label splits server (owner) from client (fetcher) traffic.
+_m_store_ops = telemetry.counter(
+    "store_ops", "Object-store operations by op kind and side")
+_m_store_bytes = telemetry.counter(
+    "store_bytes", "Object-store payload bytes moved, by direction")
 
 #: One wire chunk. Big enough to amortize framing, small enough that a
 #: slow peer never parks tens of MB in one socket write.
@@ -99,11 +108,22 @@ class StoreServer:
         with self._stats_lock:
             out = dict(self._stats)
         out.update({f"store_{k}": v for k, v in self.store.stats().items()})
+        # Exact wire volume at the framing boundary (transport/tcp.py
+        # channel counters): the tier-1 "one transfer per host" proof
+        # asserts against these, not just the app-level byte counters.
+        out["wire_bytes_tx"] = self._ep.bytes_tx
+        out["wire_bytes_rx"] = self._ep.bytes_rx
+        out["wire_frames_tx"] = self._ep.frames_tx
+        out["wire_frames_rx"] = self._ep.frames_rx
         return out
 
     def _bump(self, key: str, n: int = 1) -> None:
         with self._stats_lock:
             self._stats[key] += n
+        if key in ("bytes_served", "bytes_received"):
+            _m_store_bytes.inc(n, direction=key, side="server")
+        else:
+            _m_store_ops.inc(n, op=key, side="server")
 
     # -- serve loop -----------------------------------------------------
     def _serve_loop(self) -> None:
@@ -225,12 +245,19 @@ class StoreClient:
             "wire_bytes": 0, "lock_waits": 0, "fetch_failures": 0,
         }
 
+    def _count(self, key: str, n: int = 1) -> None:
+        self._stats[key] += n
+        if key == "wire_bytes":
+            _m_store_bytes.inc(n, direction="fetched", side="client")
+        else:
+            _m_store_ops.inc(n, op=key, side="client")
+
     # -- resolution -----------------------------------------------------
     def resolve(self, ref: ObjectRef) -> Any:
-        self._stats["resolves"] += 1
+        self._count("resolves")
         obj = self._objs.get(ref.digest)
         if obj is not None or ref.digest in self._objs:
-            self._stats["obj_cache_hits"] += 1
+            self._count("obj_cache_hits")
             return obj
         data = self.fetch_bytes(ref)
         obj = serialization.loads(data)
@@ -269,7 +296,7 @@ class StoreClient:
             # A sibling process is already fetching this object; wait
             # for its atomic cache publication instead of duplicating
             # the transfer.
-            self._stats["lock_waits"] += 1
+            self._count("lock_waits")
             deadline = time.monotonic() + LOCK_WAIT_S
             while time.monotonic() < deadline:
                 data = self.store.get_bytes(ref.digest)
@@ -308,21 +335,21 @@ class StoreClient:
             try:
                 plan.fail_point("store_fetch")
             except chaos.ChaosError as err:
-                self._stats["fetch_failures"] += 1
+                self._count("fetch_failures")
                 raise StoreFetchError(str(err)) from err
         last_err: Optional[BaseException] = None
         for attempt in range(2):
             try:
                 data = self._fetch_once(ref, fresh=attempt > 0)
-                self._stats["wire_fetches"] += 1
-                self._stats["wire_bytes"] += len(data)
+                self._count("wire_fetches")
+                self._count("wire_bytes", len(data))
                 return data
             except StoreFetchError:
                 raise  # definitive (miss / digest mismatch): no retry
             except (TransportClosed, OSError, TimeoutError) as err:
                 last_err = err
                 self._drop_conn(ref.owner)
-        self._stats["fetch_failures"] += 1
+        self._count("fetch_failures")
         raise StoreFetchError(
             f"fetch of {ref.digest[:12]} from {ref.owner} failed: "
             f"{last_err!r}")
@@ -333,11 +360,11 @@ class StoreClient:
                 timeout=_CONNECT_TIMEOUT)
         head = serialization.loads(ep.recv(timeout=_CONNECT_TIMEOUT))
         if head[0] == "miss":
-            self._stats["fetch_failures"] += 1
+            self._count("fetch_failures")
             raise StoreFetchError(
                 f"owner {ref.owner} no longer holds {ref.digest[:12]}")
         if head[0] != "ok":
-            self._stats["fetch_failures"] += 1
+            self._count("fetch_failures")
             raise StoreFetchError(f"store get error: {head!r}")
         _, size, nchunks = head
         buf = bytearray(size)

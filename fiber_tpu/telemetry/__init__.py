@@ -1,0 +1,129 @@
+"""fiber_tpu.telemetry — the cluster observability plane.
+
+Three parts (docs/observability.md):
+
+* **Metrics registry** (:mod:`.metrics`) — thread-safe Counter / Gauge /
+  Histogram with bounded label sets and a near-zero-cost disabled path,
+  instrumenting the pool task loop, transport framing, object store,
+  health plane and launcher.
+* **Task-lifecycle tracing** (:mod:`.tracing`) — Dapper-style spans with
+  a propagated ``(trace_id, parent_span_id)`` context: the master stamps
+  it into each task envelope, workers adopt it, finished spans ride back
+  on the existing result stream into the master's ring-buffer span
+  store.
+* **Export** (:mod:`.export`) — Chrome trace-event JSON (Perfetto),
+  Prometheus v0.0.4 text exposition, and an authenticated metrics
+  endpoint on the shared serve plane.
+
+Enablement follows config (``telemetry_enabled``, ``trace_sample_rate``,
+``span_buffer_size``): :func:`refresh` re-reads it, and is called from
+``fiber_tpu.init`` and the worker bootstrap so the whole process tree
+observes one setting.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Any, Dict, Optional
+
+from fiber_tpu.telemetry.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    merge_snapshots,
+)
+from fiber_tpu.telemetry import tracing  # noqa: F401
+from fiber_tpu.telemetry.tracing import (  # noqa: F401
+    SPANS,
+    current_trace_id,
+    host_id,
+    span,
+    trace_context,
+)
+
+#: The process-wide registry every fiber_tpu instrument reports into.
+REGISTRY = MetricsRegistry(enabled=True)
+
+_sample_rate = 1.0
+_rng = random.Random()
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return REGISTRY.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return REGISTRY.gauge(name, help)
+
+
+def histogram(name: str, help: str = "", **kwargs) -> Histogram:
+    return REGISTRY.histogram(name, help, **kwargs)
+
+
+def enabled() -> bool:
+    return REGISTRY.enabled
+
+
+def tracing_active() -> bool:
+    """Spans are recorded at all (the per-map sampling decision is
+    separate — :func:`maybe_start_trace`)."""
+    return REGISTRY.enabled and _sample_rate > 0.0
+
+
+def maybe_start_trace() -> Optional[str]:
+    """Sampling decision for one logical operation (one Pool map):
+    a fresh trace id, or None when telemetry is off / the sample is
+    skipped."""
+    if not REGISTRY.enabled or _sample_rate <= 0.0:
+        return None
+    if _sample_rate < 1.0 and _rng.random() >= _sample_rate:
+        return None
+    return tracing.new_id()
+
+
+def refresh() -> None:
+    """Re-read the telemetry config knobs (called from fiber_tpu.init
+    and the worker bootstrap after config adoption)."""
+    global _sample_rate
+    from fiber_tpu import config
+
+    cfg = config.get()
+    REGISTRY.enabled = bool(cfg.telemetry_enabled)
+    _sample_rate = max(0.0, min(1.0, float(cfg.trace_sample_rate)))
+    if SPANS._spans.maxlen != int(cfg.span_buffer_size):
+        SPANS.resize(int(cfg.span_buffer_size))
+
+
+def snapshot() -> Dict[str, Any]:
+    """One process's telemetry state, picklable — the payload of the
+    host agent's ``telemetry_snapshot`` op and of ``cluster_metrics``."""
+    from fiber_tpu.utils.profiling import global_timer
+
+    return {
+        "host": host_id(),
+        "pid": os.getpid(),
+        "enabled": REGISTRY.enabled,
+        "trace_sample_rate": _sample_rate,
+        "metrics": REGISTRY.snapshot(),
+        "timers": global_timer.stats(),
+        "spans_buffered": len(SPANS),
+        "spans_dropped": SPANS.dropped,
+    }
+
+
+def serve_metrics(port: int = 0, bind: str = "127.0.0.1"):
+    """Start the authenticated Prometheus endpoint for this process;
+    returns the server (``.port``, ``.stop()``)."""
+    from fiber_tpu.telemetry.export import MetricsServer
+
+    return MetricsServer(port=port, bind=bind)
+
+
+# Initial enablement from whatever config is already resolved (workers
+# re-sync in their bootstrap once the master's config arrives).
+try:  # pragma: no cover - import-order safety net
+    refresh()
+except Exception:
+    pass

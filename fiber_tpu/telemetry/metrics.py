@@ -1,0 +1,239 @@
+"""Metrics registry: thread-safe Counter / Gauge / Histogram.
+
+Design constraints (docs/observability.md):
+
+* **Near-zero-cost disabled path** — every instrument method starts with
+  one attribute read + branch on the registry's ``enabled`` flag, so hot
+  paths (transport frame loop, pool task loop) can stay instrumented
+  unconditionally.
+* **Bounded label sets** — at most :data:`MAX_LABEL_SETS` distinct label
+  combinations per metric; further ones fold into a single
+  ``other="overflow"`` series instead of growing without bound (a
+  misbehaving label like a per-task id cannot OOM the registry).
+* **Fixed histogram buckets** — bucket boundaries are chosen at
+  registration and never change, so per-host snapshots aggregate by
+  simple element-wise addition (``backends.tpu.cluster_metrics``).
+
+Instruments are process-global singletons obtained from a registry via
+``registry.counter(name, help)`` — re-registration returns the existing
+instrument (modules can declare their instruments at import time without
+coordinating).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+#: Distinct label combinations kept per metric before folding into the
+#: overflow series.
+MAX_LABEL_SETS = 64
+
+#: Default histogram boundaries, seconds — spans worker-spawn latencies
+#: (~1 s) down to sub-millisecond serialize/dispatch sections.
+DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+_OVERFLOW_KEY = (("other", "overflow"),)
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    if not labels:
+        return ()
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def key_to_str(key: Tuple[Tuple[str, str], ...]) -> str:
+    """Stable text form of a label key (snapshot dict keys must survive
+    pickling across the agent RPC plane and JSON dumps)."""
+    return ",".join(f"{k}={v}" for k, v in key)
+
+
+class _Instrument:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str,
+                 registry: "MetricsRegistry") -> None:
+        self.name = name
+        self.help = help
+        self._reg = registry
+        self._series: Dict[Tuple, object] = {}
+
+    def _slot(self, labels: Dict[str, str]) -> Tuple:
+        """Label key for this observation, bounded (caller holds the
+        registry lock)."""
+        key = _label_key(labels)
+        if key not in self._series and len(self._series) >= MAX_LABEL_SETS:
+            return _OVERFLOW_KEY
+        return key
+
+    def _snapshot_series(self) -> Dict[str, object]:
+        return {key_to_str(k): v for k, v in self._series.items()}
+
+
+class Counter(_Instrument):
+    """Monotonic float counter."""
+
+    kind = "counter"
+
+    def inc(self, n: float = 1.0, **labels: str) -> None:
+        if not self._reg.enabled:
+            return
+        with self._reg._lock:
+            key = self._slot(labels)
+            self._series[key] = self._series.get(key, 0.0) + n
+
+    def value(self, **labels: str) -> float:
+        with self._reg._lock:
+            return float(self._series.get(_label_key(labels), 0.0))
+
+
+class Gauge(_Instrument):
+    """Point-in-time value (queue depth, breaker state)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels: str) -> None:
+        if not self._reg.enabled:
+            return
+        with self._reg._lock:
+            self._series[self._slot(labels)] = float(value)
+
+    def inc(self, n: float = 1.0, **labels: str) -> None:
+        if not self._reg.enabled:
+            return
+        with self._reg._lock:
+            key = self._slot(labels)
+            self._series[key] = self._series.get(key, 0.0) + n
+
+    def dec(self, n: float = 1.0, **labels: str) -> None:
+        self.inc(-n, **labels)
+
+    def value(self, **labels: str) -> float:
+        with self._reg._lock:
+            return float(self._series.get(_label_key(labels), 0.0))
+
+
+class Histogram(_Instrument):
+    """Fixed-boundary histogram. A series is the list
+    ``[count_per_bucket..., count_above_last, sum, count]``."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str, registry: "MetricsRegistry",
+                 buckets: Sequence[float] = DEFAULT_BUCKETS) -> None:
+        super().__init__(name, help, registry)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+
+    def observe(self, value: float, **labels: str) -> None:
+        if not self._reg.enabled:
+            return
+        with self._reg._lock:
+            key = self._slot(labels)
+            series = self._series.get(key)
+            if series is None:
+                series = self._series[key] = (
+                    [0] * (len(self.buckets) + 1) + [0.0, 0]
+                )
+            series[bisect.bisect_left(self.buckets, value)] += 1
+            series[-2] += value
+            series[-1] += 1
+
+    def count(self, **labels: str) -> int:
+        with self._reg._lock:
+            series = self._series.get(_label_key(labels))
+            return int(series[-1]) if series else 0
+
+    def sum(self, **labels: str) -> float:
+        with self._reg._lock:
+            series = self._series.get(_label_key(labels))
+            return float(series[-2]) if series else 0.0
+
+
+class MetricsRegistry:
+    """Process-wide instrument table. One global instance lives in
+    ``fiber_tpu.telemetry``; separate registries exist only for tests."""
+
+    def __init__(self, enabled: bool = True) -> None:
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Instrument] = {}
+
+    def _get_or_create(self, cls, name: str, help: str, **kwargs):
+        with self._lock:
+            inst = self._metrics.get(name)
+            if inst is not None:
+                if not isinstance(inst, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{inst.kind}, not {cls.kind}")
+                return inst
+            inst = cls(name, help, self, **kwargs)
+            self._metrics[name] = inst
+            return inst
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Instrument]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Picklable dump: {name: {type, help, [buckets,] series}}.
+        Histogram series are copied lists; scalars are plain floats."""
+        with self._lock:
+            out: Dict[str, dict] = {}
+            for name, inst in self._metrics.items():
+                entry: dict = {
+                    "type": inst.kind,
+                    "help": inst.help,
+                    "series": {
+                        k: (list(v) if isinstance(v, list) else v)
+                        for k, v in inst._snapshot_series().items()
+                    },
+                }
+                if isinstance(inst, Histogram):
+                    entry["buckets"] = list(inst.buckets)
+                out[name] = entry
+            return out
+
+    def reset(self) -> None:
+        """Clear every series (instruments stay registered) — tests."""
+        with self._lock:
+            for inst in self._metrics.values():
+                inst._series.clear()
+
+
+def merge_snapshots(snapshots: Dict[str, Dict[str, dict]]) -> Dict[str, dict]:
+    """Aggregate per-host ``registry.snapshot()`` dicts into one, adding
+    a ``host=<key>`` label to every series so per-host structure
+    survives the merge (the shape ``cluster_metrics`` renders)."""
+    merged: Dict[str, dict] = {}
+    for host, snap in snapshots.items():
+        if not isinstance(snap, dict):
+            continue
+        for name, entry in snap.items():
+            slot = merged.setdefault(name, {
+                "type": entry.get("type", "untyped"),
+                "help": entry.get("help", ""),
+                "series": {},
+            })
+            if "buckets" in entry and "buckets" not in slot:
+                slot["buckets"] = entry["buckets"]
+            for key, value in entry.get("series", {}).items():
+                label = f"host={host}" + (f",{key}" if key else "")
+                slot["series"][label] = value
+    return merged

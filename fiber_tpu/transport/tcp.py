@@ -26,7 +26,7 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
-from fiber_tpu import auth
+from fiber_tpu import auth, telemetry
 from fiber_tpu.testing import chaos
 from fiber_tpu.framing import (
     ConnectionClosed,
@@ -37,6 +37,22 @@ from fiber_tpu.utils.logging import get_logger
 from fiber_tpu.utils.net import random_port_bind
 
 logger = get_logger()
+
+# Cluster-wide wire volume (docs/observability.md). Per-endpoint EXACT
+# counters live on Endpoint.bytes_tx/bytes_rx/frames_tx/frames_rx —
+# these registry twins aggregate across every endpoint in the process.
+_m_bytes_tx = telemetry.counter(
+    "transport_bytes_tx", "Wire bytes sent (framing headers included)")
+_m_bytes_rx = telemetry.counter(
+    "transport_bytes_rx", "Wire bytes received (framing headers included)")
+_m_frames_tx = telemetry.counter("transport_frames_tx", "Frames sent")
+_m_frames_rx = telemetry.counter("transport_frames_rx", "Frames received")
+_m_connect_retries = telemetry.counter(
+    "transport_connect_retries",
+    "connect() attempts that failed and were retried")
+
+#: Wire overhead per frame: 8-byte length header + 1-byte type prefix.
+_FRAME_OVERHEAD = 9
 
 MODES = ("r", "w", "rw", "req", "rep")
 
@@ -115,6 +131,13 @@ class _Channel:
         self.credit = 0  # how many frames the peer is ready to accept
         self.replenish_owed = 0  # batched standing-window replenish
         self.last_rx: Optional[float] = None  # monotonic, any frame kind
+        # Exact wire-volume counters at the framing boundary (monotonic;
+        # single-writer each: rx by this channel's reader thread, tx
+        # under _send_lock — so reads need no extra locking).
+        self.bytes_rx = 0
+        self.bytes_tx = 0
+        self.frames_rx = 0
+        self.frames_tx = 0
         self._send_lock = threading.Lock()
         sock.setsockopt(pysocket.IPPROTO_TCP, pysocket.TCP_NODELAY, 1)
         self._reader: Optional[threading.Thread] = None
@@ -135,6 +158,10 @@ class _Channel:
                 # instead of opening extra sockets; credit frames count
                 # too (any byte proves the peer's stack is alive).
                 self.last_rx = self.owner.last_rx = time.monotonic()
+                self.bytes_rx += len(frame) + 8  # + length header
+                self.frames_rx += 1
+                _m_bytes_rx.inc(len(frame) + 8)
+                _m_frames_rx.inc()
                 kind = frame[:1]
                 if kind == _T_CREDIT:
                     (n,) = _CREDIT.unpack(frame[1:5])
@@ -186,10 +213,16 @@ class _Channel:
     def send(self, payload: bytes) -> None:
         with self._send_lock:
             send_frame(self.sock, payload, prefix=_T_DATA)
+            self.bytes_tx += len(payload) + _FRAME_OVERHEAD
+            self.frames_tx += 1
+        _m_bytes_tx.inc(len(payload) + _FRAME_OVERHEAD)
+        _m_frames_tx.inc()
 
     def send_credit(self, n: int) -> None:
         with self._send_lock:
             send_frame(self.sock, _T_CREDIT + _CREDIT.pack(n))
+            self.bytes_tx += _CREDIT.size + _FRAME_OVERHEAD
+            self.frames_tx += 1
 
     def close(self) -> None:
         self.alive = False
@@ -238,6 +271,10 @@ class Endpoint:
         #: detector observes silence through this instead of extra
         #: sockets; per-connection granularity lives on _Channel.last_rx.
         self.last_rx: Optional[float] = None
+        # Wire totals of channels that have already been dropped, so the
+        # endpoint aggregates (bytes_tx etc.) stay monotonic across
+        # reconnects.
+        self._dead_wire = [0, 0, 0, 0]  # bytes_rx, bytes_tx, f_rx, f_tx
 
     # -- wiring -----------------------------------------------------------
     def bind(self, ip: str, port: int = 0) -> str:
@@ -291,6 +328,7 @@ class Endpoint:
             except OSError:
                 if attempt >= retries:
                     raise
+                _m_connect_retries.inc()
                 time.sleep(min(retry_base * (2 ** attempt), 2.0))
                 attempt += 1
         sock.settimeout(None)
@@ -375,6 +413,11 @@ class Endpoint:
         with self._chan_lock:
             if chan in self._channels:
                 self._channels.remove(chan)
+                dead = self._dead_wire
+                dead[0] += chan.bytes_rx
+                dead[1] += chan.bytes_tx
+                dead[2] += chan.frames_rx
+                dead[3] += chan.frames_tx
             now_empty = not self._channels
         chan.close()
         # A connected endpoint has no listener: losing its only channel is
@@ -582,6 +625,30 @@ class Endpoint:
     def _is_closed_head(self) -> bool:
         head = self._inbox.peek(0)
         return head is _SENTINEL
+
+    # -- wire-volume counters (framing boundary, exact) -------------------
+    def _wire_total(self, idx: int, attr: str) -> int:
+        with self._chan_lock:
+            return self._dead_wire[idx] + sum(
+                getattr(c, attr) for c in self._channels)
+
+    @property
+    def bytes_rx(self) -> int:
+        """Monotonic wire bytes received across every channel this
+        endpoint ever had (length headers included)."""
+        return self._wire_total(0, "bytes_rx")
+
+    @property
+    def bytes_tx(self) -> int:
+        return self._wire_total(1, "bytes_tx")
+
+    @property
+    def frames_rx(self) -> int:
+        return self._wire_total(2, "frames_rx")
+
+    @property
+    def frames_tx(self) -> int:
+        return self._wire_total(3, "frames_tx")
 
     # -- lifecycle --------------------------------------------------------
     def peer_count(self) -> int:

@@ -6,19 +6,55 @@ initializes at import; workers re-init inside the spawn bootstrap after the
 parent's config has been adopted, so every process in the tree logs to its
 own file with one shared format (tested by reference tests/test_misc.py
 per-process log-file separation).
+
+Every record additionally carries the cluster context —
+``[host job trace]`` — injected by :class:`ContextFilter` (dash when
+absent), so a grep for one trace id crosses master, host-agent, and
+worker log files (docs/observability.md).
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import sys
 
 LOGGER_NAME = "fiber_tpu"
 
 FORMAT = (
     "%(asctime)s %(levelname)s:%(processName)s(%(process)d)"
-    ":%(threadName)s:%(name)s {%(filename)s:%(lineno)d} %(message)s"
+    ":%(threadName)s:%(name)s [%(fiber_host)s %(fiber_job)s "
+    "%(fiber_trace)s] {%(filename)s:%(lineno)d} %(message)s"
 )
+
+
+class ContextFilter(logging.Filter):
+    """Stamp host id / job id / current trace id onto every record.
+
+    * host — FIBER_HOST_ID env or the hostname (telemetry's host_id);
+    * job — the launch ident this process was spawned under
+      (FIBER_LAUNCH_IDENT, shortened), "-" on the master;
+    * trace — the thread's ambient telemetry trace id, "-" outside one.
+
+    Lookups are lazy and failure-proof: logging must keep working during
+    interpreter teardown and before telemetry is importable."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        try:
+            from fiber_tpu.telemetry import tracing
+
+            record.fiber_host = tracing.host_id()
+            record.fiber_trace = tracing.current_trace_id() or "-"
+        except Exception:
+            record.fiber_host = "-"
+            record.fiber_trace = "-"
+        ident = os.environ.get("FIBER_LAUNCH_IDENT", "")
+        record.fiber_job = f"j{int(ident) % 10 ** 8}" if ident.isdigit() \
+            else "-"
+        return True
+
+
+_context_filter = ContextFilter()
 
 
 def get_logger() -> logging.Logger:
@@ -51,5 +87,9 @@ def init_logger(cfg, process_name: str | None = None) -> logging.Logger:
         except OSError:
             handler = logging.StreamHandler(sys.stderr)
     handler.setFormatter(logging.Formatter(FORMAT))
+    # On the logger, not the handler: the context attrs must exist on
+    # every record no matter which handler formats it.
+    if _context_filter not in logger.filters:
+        logger.addFilter(_context_filter)
     logger.addHandler(handler)
     return logger

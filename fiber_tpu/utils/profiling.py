@@ -8,10 +8,15 @@ equivalent — this is a gap, not a port target"). fiber_tpu provides:
   device-plane region (ES generations, device_map calls) produces a
   TensorBoard-loadable XLA trace;
 * ``annotate(name)`` — ``jax.profiler.TraceAnnotation`` passthrough for
-  labelling host-side regions inside a trace;
+  labelling host-side regions inside a trace; the same region is also
+  recorded as a fiber_tpu telemetry span, so XLA profiler regions and
+  cluster task traces line up in one timeline (docs/observability.md);
 * ``Timer`` / ``timed`` — lightweight host-plane timing with aggregated
-  stats, used by the pool to expose per-phase timings
-  (``pool.stats()``-style introspection without a profiler UI).
+  stats. The process-wide ``global_timer`` mirrors every section into
+  the telemetry registry's ``timer_seconds`` histogram (label:
+  ``section``), so there is ONE timing surface: ``Pool.stats()`` reads
+  the timer, exporters read the registry, and both see the same
+  sections.
 """
 
 from __future__ import annotations
@@ -36,21 +41,46 @@ def trace(log_dir: str) -> Iterator[None]:
         jax.profiler.stop_trace()
 
 
-def annotate(name: str):
-    """Label a region inside an active trace."""
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Label a region inside an active XLA trace AND record it as a
+    telemetry span (joining the ambient trace context if one is set)."""
     import jax
 
-    return jax.profiler.TraceAnnotation(name)
+    from fiber_tpu.telemetry import tracing as _tracing
+
+    with jax.profiler.TraceAnnotation(name):
+        with _tracing.span(name, kind="jax.annotation"):
+            yield
 
 
 class Timer:
     """Aggregating wall-clock timer: ``with timer.section("pickle"): ...``;
-    ``timer.stats()`` returns {section: (count, total_s, mean_s)}."""
+    ``timer.stats()`` returns {section: (count, total_s, mean_s)}.
 
-    def __init__(self) -> None:
+    ``mirror=True`` (the process-wide ``global_timer``) additionally
+    feeds each observation into the telemetry registry's
+    ``timer_seconds`` histogram so the one set of sections reaches the
+    Prometheus/Snapshot exporters too."""
+
+    def __init__(self, mirror: bool = False) -> None:
         self._totals: Dict[str, float] = defaultdict(float)
         self._counts: Dict[str, int] = defaultdict(int)
         self._lock = threading.Lock()
+        self._mirror = mirror
+        self._hist = None
+
+    def _observe_mirror(self, name: str, seconds: float) -> None:
+        if not self._mirror:
+            return
+        if self._hist is None:
+            from fiber_tpu import telemetry
+
+            self._hist = telemetry.histogram(
+                "timer_seconds",
+                "global_timer sections (one timing surface: "
+                "Timer.stats() and this histogram see the same data)")
+        self._hist.observe(seconds, section=name)
 
     @contextlib.contextmanager
     def section(self, name: str) -> Iterator[None]:
@@ -62,11 +92,13 @@ class Timer:
             with self._lock:
                 self._totals[name] += elapsed
                 self._counts[name] += 1
+            self._observe_mirror(name, elapsed)
 
     def add(self, name: str, seconds: float) -> None:
         with self._lock:
             self._totals[name] += seconds
             self._counts[name] += 1
+        self._observe_mirror(name, seconds)
 
     def stats(self) -> Dict[str, tuple]:
         with self._lock:
@@ -86,7 +118,7 @@ class Timer:
 
 
 #: Process-wide timer the pool and transport report into.
-global_timer = Timer()
+global_timer = Timer(mirror=True)
 
 
 @contextlib.contextmanager

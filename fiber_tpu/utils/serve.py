@@ -285,3 +285,35 @@ def serve_authenticated(listener, authkey: bytes,
             _force_eof(evict)  # its guarded() thread fails fast + cleans up
         threading.Thread(target=guarded, args=(conn,),
                          name=thread_name, daemon=True).start()
+
+
+def serve_request_reply(listener, authkey: bytes,
+                        stop_event: threading.Event,
+                        answer: Callable,
+                        thread_name: str) -> None:
+    """:func:`serve_authenticated` specialized to the request->reply
+    convention every agent-style plane speaks: per request the handler
+    sends ``(True, answer(request))``, or ``(False, repr(exc))`` when
+    ``answer`` raises — so :class:`fiber_tpu.backends.tpu.AgentClient`
+    can talk to any such plane (the telemetry endpoint uses this)."""
+
+    def handler(conn) -> None:
+        try:
+            while True:
+                request = conn.recv()
+                try:
+                    result = answer(request)
+                except BaseException as exc:  # noqa: BLE001
+                    conn.send((False, repr(exc)))
+                    continue
+                conn.send((True, result))
+        except (EOFError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    serve_authenticated(listener, authkey, stop_event, handler,
+                        thread_name)

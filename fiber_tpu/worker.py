@@ -77,6 +77,14 @@ def _apply_preparation(prep: dict) -> None:
 
     config.init_from(prep["fiber_config"])
 
+    # Telemetry enablement / sampling / span-buffer capacity follow the
+    # master's config, adopted above — so one knob governs the whole
+    # process tree, and spans this worker records (pool.py task loop)
+    # join the trace ids the master stamps into task envelopes.
+    from fiber_tpu import telemetry
+
+    telemetry.refresh()
+
     name = prep.get("name", "FiberWorker")
     mp_proc = multiprocessing.current_process()
     mp_proc.name = name  # so %(processName)s in log lines matches

@@ -245,8 +245,10 @@ def test_wire_put_rejects_digest_mismatch(wire):
 def test_pool_broadcast_dedup_once_per_host():
     """Acceptance: Pool.map over >= 32 tasks sharing an 8 MB arg moves
     the payload over the wire ONCE for the whole (single-host) worker
-    set — proven by the master store-server counters — and every task
-    still computes on the real array."""
+    set — proven by the store server's app counters AND the transport's
+    exact framing-boundary byte counters (a second transfer would land
+    ~2x the payload on the wire) — and every task still computes on the
+    real array."""
     arr = unique_array(8.0)
     with fiber_tpu.Pool(2) as pool:
         before = pool.store_stats()
@@ -259,6 +261,13 @@ def test_pool_broadcast_dedup_once_per_host():
     assert after["gets"] - before.get("gets", 0) == 1
     served = after["bytes_served"] - before.get("bytes_served", 0)
     assert served >= arr.nbytes
+    # Exact wire volume (Endpoint.bytes_tx at the framing boundary):
+    # one 8 MB transfer plus small control replies — strictly under the
+    # two-transfer mark. Server-side app counters alone couldn't see a
+    # hypothetical duplicate send that never reached self._bump.
+    wire_tx = after["wire_bytes_tx"] - before.get("wire_bytes_tx", 0)
+    assert arr.nbytes <= wire_tx < 2 * arr.nbytes
+    assert after["wire_frames_tx"] > before.get("wire_frames_tx", 0)
     assert after["inline_fallbacks"] == 0
 
 

@@ -4,7 +4,7 @@
 PYTEST ?= python -m pytest tests/ -q
 
 .PHONY: test stest test-all lint bench bench-store bench-telemetry \
-	weakscale docs chaos
+	bench-sched weakscale docs chaos
 
 # Tier 1: local backend (subprocess jobs)
 test:
@@ -55,6 +55,14 @@ bench-store:
 bench-telemetry:
 	JAX_PLATFORMS=cpu python bench.py --telemetry > BENCH_telemetry.json; \
 	rc=$$?; cat BENCH_telemetry.json; exit $$rc
+
+# Scheduler-plane gate (docs/scheduling.md): uniform-workload overhead
+# of the adaptive scheduler vs fifo (must stay within 5%) and straggler
+# speculation on vs off under one chaos-slowed worker (must be >= 1.3x
+# faster). The record lands in BENCH_sched.json either way.
+bench-sched:
+	JAX_PLATFORMS=cpu python bench.py --sched > BENCH_sched.json; \
+	rc=$$?; cat BENCH_sched.json; exit $$rc
 
 # Weak-scaling record over 1/2/4/8-device sim meshes (fused ES,
 # population scaled with devices) + strong curve (constant total pop)

@@ -274,6 +274,108 @@ def _telemetry_bench(args) -> int:
     return 0
 
 
+#: Minimum straggler-scenario speedup (speculation on vs off) the
+#: `make bench-sched` gate demands, and the max uniform-workload wall
+#: ratio (adaptive scheduler vs plain fifo handout) it tolerates.
+_SCHED_SPEEDUP_FLOOR = 1.3
+_SCHED_OVERHEAD_BUDGET = 1.05
+
+
+def _sched_bench(args) -> int:
+    """Scheduler-plane microbench (docs/scheduling.md), two scenarios:
+
+    * **uniform** — evenly-sized tasks, healthy workers: the adaptive
+      scheduler (locality + WDRR, speculation off) must stay within 5%
+      of the plain fifo handout;
+    * **straggler** — one chaos-slowed worker (``slow_worker`` knob:
+      alive, heartbeating, just slow): speculation ON must beat
+      speculation OFF by >= 1.3x map wall-clock, because duplicated
+      straggler chunks complete on idle workers instead of serializing
+      behind the slow host.
+
+    Emits one JSON line per measurement plus a summary; exits nonzero
+    when either gate fails. Best-of-N walls so a CI scheduler hiccup
+    can't fail the gate."""
+    import tempfile
+
+    os.environ["FIBER_BACKEND"] = "local"
+    import fiber_tpu
+    from fiber_tpu.testing import chaos as chaosmod
+
+    workers, reps = 4, int(args.sched_reps)
+
+    def run_uniform(policy: str) -> float:
+        fiber_tpu.init(worker_lite=True, sched_policy=policy,
+                       speculation_enabled=False)
+        best = None
+        for _ in range(reps):
+            with fiber_tpu.Pool(workers) as pool:
+                pool.map(_timed_task, [0.0] * workers)  # spin-up barrier
+                t0 = time.perf_counter()
+                pool.map(_timed_task, [0.002] * 400)
+                wall = time.perf_counter() - t0
+            best = wall if best is None else min(best, wall)
+        return best
+
+    def run_straggler(speculate: bool) -> float:
+        best = None
+        for _ in range(reps):
+            # Fresh token dir per repetition: exactly one worker claims
+            # the slow token after the spin-up barrier (its 1st chunk)
+            # and straggles for the whole timed map.
+            plan = chaosmod.ChaosPlan(
+                seed=7,
+                token_dir=tempfile.mkdtemp(prefix="fiber-bench-sched-"),
+                slow_worker_after_chunks=1, slow_worker_s=0.75,
+                slow_worker_times=1)
+            chaosmod.install(plan)
+            try:
+                fiber_tpu.init(worker_lite=True, sched_policy="adaptive",
+                               speculation_enabled=speculate,
+                               speculation_quantile=2.0)
+                with fiber_tpu.Pool(workers) as pool:
+                    pool.map(_timed_task, [0.0] * workers)
+                    t0 = time.perf_counter()
+                    pool.map(_timed_task, [0.004] * 160, chunksize=2)
+                    wall = time.perf_counter() - t0
+            finally:
+                chaosmod.uninstall()
+            best = wall if best is None else min(best, wall)
+        return best
+
+    fifo = run_uniform("fifo")
+    adaptive = run_uniform("adaptive")
+    overhead = round(adaptive / fifo, 4)
+    for mode, wall in (("fifo", fifo), ("adaptive", adaptive)):
+        _emit({"metric": f"sched_uniform_{mode}_tasks_per_sec",
+               "value": round(400 / wall, 1), "unit": "tasks/s",
+               "wall_s": round(wall, 4)})
+    spec_off = run_straggler(False)
+    spec_on = run_straggler(True)
+    fiber_tpu.init()
+    speedup = round(spec_off / spec_on, 4)
+    for mode, wall in (("off", spec_off), ("on", spec_on)):
+        _emit({"metric": f"sched_straggler_speculation_{mode}_wall_s",
+               "value": round(wall, 4), "unit": "s",
+               "tasks": 160, "slow_worker_s": 0.75})
+    over = overhead > _SCHED_OVERHEAD_BUDGET
+    slow = speedup < _SCHED_SPEEDUP_FLOOR
+    _emit({"metric": "sched_gates",
+           "straggler_speedup": speedup,
+           "speedup_floor": _SCHED_SPEEDUP_FLOOR,
+           "uniform_overhead": overhead,
+           "overhead_budget": _SCHED_OVERHEAD_BUDGET,
+           "over_budget": bool(over), "under_speedup": bool(slow)})
+    if over:
+        print(f"FAIL: adaptive-scheduler uniform overhead {overhead} "
+              f"exceeds budget {_SCHED_OVERHEAD_BUDGET}",
+              file=sys.stderr)
+    if slow:
+        print(f"FAIL: straggler speculation speedup {speedup} below "
+              f"floor {_SCHED_SPEEDUP_FLOOR}", file=sys.stderr)
+    return 1 if (over or slow) else 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--platform", default="",
@@ -334,6 +436,17 @@ def main() -> int:
                              "plane (runs on JAX_PLATFORMS=cpu)")
     parser.add_argument("--telemetry-reps", type=int, default=3,
                         help="walls per mode for --telemetry (best-of)")
+    parser.add_argument("--sched", action="store_true",
+                        help="bench the scheduler plane instead "
+                             "(docs/scheduling.md): uniform-workload "
+                             "overhead of the adaptive scheduler vs "
+                             "fifo, and straggler speculation on vs "
+                             "off under a chaos-slowed worker; fails "
+                             "past 5% overhead or under 1.3x straggler "
+                             "speedup. Pure host plane (runs on "
+                             "JAX_PLATFORMS=cpu)")
+    parser.add_argument("--sched-reps", type=int, default=3,
+                        help="walls per scenario for --sched (best-of)")
     parser.add_argument("--profile", default="",
                         help="write a jax.profiler trace of the timed ES "
                              "section to this directory (inspect with "
@@ -344,15 +457,17 @@ def main() -> int:
     if args.gens < 1:
         parser.error("--gens must be >= 1")
     if sum((args.poet, args.pixels, args.biped, args.attention,
-            args.lm, args.store, args.telemetry)) > 1:
+            args.lm, args.store, args.telemetry, args.sched)) > 1:
         parser.error("--poet/--pixels/--biped/--attention/--lm/--store/"
-                     "--telemetry are mutually exclusive")
+                     "--telemetry/--sched are mutually exclusive")
     if args.store:
         # Host-plane only: no accelerator probe, no watchdog — the
         # store bench must run identically on a laptop and a pod host.
         return _store_bench(args)
     if args.telemetry:
         return _telemetry_bench(args)  # host-plane only, like --store
+    if args.sched:
+        return _sched_bench(args)  # host-plane only, like --store
     if args.pop is not None and args.pop < 2:
         parser.error("--pop must be >= 2")
     if args.steps is not None and args.steps < 1:

@@ -338,6 +338,12 @@ class TpuBackend(Backend):
         host = self._pick_host(job_spec)
         agent = self._agent(host)
         env = dict(job_spec.env or {})
+        # Placement identity for the scheduler plane
+        # (docs/scheduling.md): only this backend knows which host the
+        # job landed on, so it stamps the key — the same "ip:port" the
+        # host tables (host_health/store_stats/locate_object) use —
+        # into the job env; pool workers echo it in "ready" frames.
+        env.setdefault("FIBER_HOST_KEY", f"{host[0]}:{host[1]}")
         # Resource hints become agent-enforced limits (affinity + rlimit),
         # the reference's k8s/docker limit role. Device jobs keep all host
         # cores — pinning a jax host process to cpu_per_job cores would
@@ -501,6 +507,33 @@ class TpuBackend(Backend):
             agent.call("store_put", digest, bytes(data))
             pushed += 1
         return pushed
+
+    def host_suspect(self, host_key: str) -> bool:
+        """Scheduler-plane health input: True when the keyed host is
+        currently suspect (silent past suspect_timeout) or its spawn
+        breaker is open — the pool's handout gate parks its workers'
+        requests while healthier peers exist (docs/scheduling.md)."""
+        host, _, port_s = host_key.rpartition(":")
+        if not host or not port_s.isdigit():
+            return False
+        key = (host, int(port_s))
+        if self._detector is not None and self._detector.is_suspect(key):
+            return True
+        return not self._host_breaker.allow(key)
+
+    def locate_object(self, digest: str) -> List[str]:
+        """Hosts whose object cache already holds ``digest`` (agent
+        ``store_has``), keyed like :meth:`host_health` — the scheduler's
+        placement probe for prestaged broadcasts. Best-effort: an
+        unreachable agent just drops out of the answer."""
+        out: List[str] = []
+        for host in self._hosts:
+            try:
+                if self._agent(host).call("store_has", digest):
+                    out.append(f"{host[0]}:{host[1]}")
+            except Exception:  # noqa: BLE001 - locality is optional
+                continue
+        return out
 
     def store_stats(self) -> Dict[str, dict]:
         """Per-host object-cache counters, the store-plane sibling of

@@ -454,7 +454,23 @@ def _probe_agent(host: str, port: int):
         client.close()
 
 
+def _render_sched(snaps, indent: str = "  ") -> None:
+    """Print scheduler-plane snapshots (docs/scheduling.md): per-pool
+    queue depth and per-host in-flight chunk counts, beside the
+    host_health/store_stats surfaces."""
+    for s in snaps or []:
+        print(f"{indent}sched policy={s.get('policy')} "
+              f"queued={s.get('queued')} inflight={s.get('inflight')} "
+              f"decisions={s.get('decisions')}")
+        for hk, n in sorted((s.get("hosts") or {}).items()):
+            print(f"{indent}  host {hk} inflight={n}")
+        for mseq, depth in sorted((s.get("maps") or {}).items()):
+            print(f"{indent}  map {mseq} queued={depth}")
+
+
 def cmd_status(args) -> int:
+    from fiber_tpu.backends.tpu import AgentClient
+
     rc = 0
     for host, port in _resolve_cli_hosts(args):
         try:
@@ -464,6 +480,17 @@ def cmd_status(args) -> int:
         except Exception as err:
             print(f"{host}:{port}  DOWN  ({err})")
             rc = 1
+            continue
+        # Scheduler snapshot (best-effort: pre-sched agents and masters
+        # without pools simply have none to show).
+        client = AgentClient(host, port)
+        try:
+            snap = client.call("telemetry_snapshot")
+            _render_sched(snap.get("sched"), indent="    ")
+        except Exception:  # noqa: BLE001
+            pass
+        finally:
+            client.close()
     return rc
 
 
@@ -637,6 +664,7 @@ def cmd_metrics(args) -> int:
                 print(f"  {name}{rendered} {value}")
         for section, stat in sorted(snap.get("timers", {}).items()):
             print(f"  timer {section} count={stat[0]} total_s={stat[1]}")
+        _render_sched(snap.get("sched"))
     return rc
 
 

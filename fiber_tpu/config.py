@@ -54,6 +54,25 @@ DEFAULTS: Dict[str, Any] = {
     # in pool.py must still fire within ~a minute on a dead backend.
     "spawn_breaker_backoff": 0.25,
     "spawn_breaker_backoff_max": 2.0,
+    # --- scheduler plane (docs/scheduling.md) ---
+    # Pool handout policy: "adaptive" = locality-aware placement + fair
+    # multi-map queueing (and, when enabled below, straggler
+    # speculation); "fifo" = the reference's plain arrival-order
+    # handout (also the bench.py --sched A/B baseline).
+    "sched_policy": "adaptive",
+    # Prefer handing ref-bearing chunks to workers on hosts whose store
+    # already caches the referenced objects.
+    "locality_enabled": True,
+    # Launch a speculative duplicate of a straggling chunk (first
+    # result wins; the loser is discarded idempotently). Off by
+    # default: duplicates are only safe for idempotent task functions
+    # WITHOUT side effects — stricter than the resilient pool's
+    # baseline contract, which duplicates only on worker death.
+    "speculation_enabled": False,
+    # A dispatched chunk older than this multiple of its map's median
+    # service time (with spare workers idle and the queue drained) is
+    # speculated.
+    "speculation_quantile": 4.0,
     # --- data plane ---
     "use_push_queue": True,
     # --- object store (docs/objectstore.md) ---

@@ -165,6 +165,15 @@ class JobLauncher:
         from fiber_tpu.utils.misc import package_pythonpath
 
         env = {"FIBER_WORKER": "1", "PYTHONPATH": package_pythonpath()}
+        active_plan = chaos._plan
+        if active_plan is not None:
+            # The active fault schedule rides the job env explicitly.
+            # Inheriting the master's os.environ only works for
+            # direct-subprocess backends: agent-spawned jobs get the
+            # AGENT's environment, captured at agent boot — a plan
+            # installed after that would silently never reach the
+            # workers (and a chaos run would be vacuously green).
+            env[chaos.ENV_VAR] = active_plan.to_env()
         if cfg.code_staging != "off":
             staged = self._ensure_code_staged()
             if staged:

@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from fiber_tpu import serialization, telemetry
 from fiber_tpu.meta import get_meta
+from fiber_tpu.sched import Scheduler, local_host_key
 from fiber_tpu.store.core import ObjectRef
 from fiber_tpu.store.plane import StoreFetchError
 from fiber_tpu.telemetry import tracing
@@ -448,6 +449,40 @@ def _payload_size_hint(obj: Any) -> Optional[int]:
     return None
 
 
+def _chunk_spans(n_items: int, chunksize: int) -> List[Tuple[int, int]]:
+    """Balanced remainder chunking: split ``n_items`` into
+    ``ceil(n/chunksize)`` near-equal spans (sizes differ by at most 1,
+    none above ``chunksize``) instead of fixed-size chunks plus one
+    small straggler tail. ``chunksize`` keeps its explicit-override
+    meaning as the chunk-size CAP; only the remainder is rebalanced —
+    an evenly divisible length produces exactly the classic chunks.
+    Returns ``[(base, size), ...]``."""
+    chunksize = max(1, int(chunksize))
+    nchunks = max(1, -(-n_items // chunksize))
+    base_size, rem = divmod(n_items, nchunks)
+    spans: List[Tuple[int, int]] = []
+    offset = 0
+    for i in range(nchunks):
+        size = base_size + (1 if i < rem else 0)
+        spans.append((offset, size))
+        offset += size
+    return spans
+
+
+def _chunk_digests(chunk: List[Any]) -> List[str]:
+    """Object digests this chunk's items reference (top level or one
+    tuple level deep — exactly where the encoder puts refs); the
+    scheduler's locality key set."""
+    digs: List[str] = []
+    for item in chunk:
+        if isinstance(item, ObjectRef):
+            digs.append(item.digest)
+        elif type(item) is tuple:
+            digs.extend(e.digest for e in item
+                        if isinstance(e, ObjectRef))
+    return digs
+
+
 def _chunk_has_refs(chunk: List[Any]) -> bool:
     for item in chunk:
         if isinstance(item, ObjectRef):
@@ -825,13 +860,20 @@ def _pool_worker_core(
         # With maxtasksperchild the thread stops fetching at the budget,
         # so recycling can never strand a staged chunk.
         next_task = pyqueue.Queue(maxsize=1)
+        # Placement identity rides every "ready" frame so the master's
+        # scheduler can route ref-bearing chunks to the hosts that
+        # already cache their objects (docs/scheduling.md). Backends
+        # that pick the host stamp FIBER_HOST_KEY into the job env;
+        # local workers share the machine's host id.
+        host_key = local_host_key()
 
         def fetch_loop() -> None:
             fetched = 0
             try:
                 while True:
                     task_ep.send(
-                        serialization.dumps(("ready", ident, fiber_pid))
+                        serialization.dumps(
+                            ("ready", ident, fiber_pid, host_key))
                     )
                     msg = serialization.loads(task_ep.recv())
                     next_task.put(msg)
@@ -899,8 +941,12 @@ def _pool_worker_core(
                 # Hang BEFORE compute (the held chunk is what the
                 # detector must get resubmitted); kill AFTER a result
                 # (so the death strands staged/queued chunks, the
-                # resubmission case worth inducing).
+                # resubmission case worth inducing). A slow token turns
+                # this worker into a living straggler — heartbeats keep
+                # flowing, the scheduler's speculation is what must
+                # route around it.
                 plan.maybe_hang_worker(completed_chunks)
+                plan.maybe_slow_worker(completed_chunks)
             with contextlib.ExitStack() as tstack:
                 if tctx is not None:
                     # Adopt the master's trace so every span below
@@ -1060,9 +1106,27 @@ class Pool:
         self._store_fallbacks = 0
 
         self._store = ResultStore()
-        # Items are (payload, (seq, base)) — the key rides alongside so the
-        # resilient handout never has to re-deserialize the payload.
-        self._taskq: "pyqueue.Queue" = pyqueue.Queue()
+        # Scheduler plane (fiber_tpu/sched, docs/scheduling.md): the
+        # task queue IS the per-pool scheduler — items stay
+        # (payload, (seq, base)) tuples and every existing requeue path
+        # (death reclaim, storemiss resend, reply-failure) routes
+        # through policy unchanged. Speculation only arms on the
+        # resilient pool: it needs the pending table + dedup-on-fill
+        # machinery that makes duplicate execution safe.
+        #: ident -> host placement key self-reported in "ready" frames.
+        self._ident_hosts: Dict[bytes, Optional[str]] = {}
+        self._host_suspect_fn = getattr(get_backend(), "host_suspect",
+                                        None)
+        self._sched = Scheduler(
+            n_workers=processes,
+            policy=str(cfg.sched_policy),
+            locality=bool(cfg.locality_enabled),
+            speculation=bool(cfg.speculation_enabled) and self._resilient,
+            speculation_quantile=float(cfg.speculation_quantile),
+            is_done=self._store.is_done,
+            on_new_work=self._on_sched_work,
+        )
+        self._taskq = self._sched
 
         self._workers: List = []
         self._workers_lock = threading.Lock()
@@ -1258,6 +1322,47 @@ class Pool:
     def _on_worker_death(self, proc) -> None:
         logger.debug("pool worker %s died", proc.name)
 
+    # -- scheduler plane hooks (fiber_tpu/sched) ---------------------------
+    def _on_sched_work(self) -> None:
+        """The speculation monitor queued a duplicate: parked requests'
+        reservation gates may now clear — nudge the handout loop (same
+        posture as the submit/result-side wake twins)."""
+        if getattr(self, "_parked_count", 0):
+            try:
+                self._task_ep.wake()
+            except (TransportClosed, OSError):
+                pass
+
+    def _suspect_defers(self, ident: bytes) -> bool:
+        """Health-plane placement input: True when this requester's host
+        is currently suspect (backend failure detector / open spawn
+        breaker) AND healthier workers exist AND work is scarce enough
+        that giving the suspect host a chunk risks stranding it. With
+        chunks plentiful even a suspect host helps; with every host
+        suspect, serving beats a placement deadlock."""
+        fn = self._host_suspect_fn
+        if fn is None:
+            return False
+        host = self._ident_hosts.get(ident)
+        if host is None:
+            return False
+        try:
+            if not fn(host):
+                return False
+        except Exception:  # noqa: BLE001 - health probe must never wedge
+            return False
+        if self._taskq.qsize() > self._n_workers:
+            return False
+        for other_host in self._ident_hosts.values():
+            if other_host is None or other_host == host:
+                continue
+            try:
+                if not fn(other_host):
+                    return True
+            except Exception:  # noqa: BLE001
+                continue
+        return False
+
     # -- task egress -------------------------------------------------------
     def _task_loop(self) -> None:
         """Move tasks from the local queue onto the wire with explicit
@@ -1409,6 +1514,26 @@ class Pool:
 
         self._store.add_callback(seq, _cleanup)
 
+    def _probe_ref_locations(self, digests: List[str]) -> None:
+        """Ask the backend which hosts already cache these objects
+        (host-agent ``store_has``, the path ``put_object`` prestages
+        through) and feed the scheduler's locality map. Bounded to a
+        handful of digests per map and entirely best-effort: a slow or
+        dead agent costs the optimization, never the submit."""
+        if not self._sched.locality:
+            return
+        from fiber_tpu.backends import get_backend
+
+        locate = getattr(get_backend(), "locate_object", None)
+        if locate is None:
+            return
+        for dig in list(dict.fromkeys(digests))[:4]:
+            try:
+                for host in locate(dig):
+                    self._sched.note_host_has(host, (dig,))
+            except Exception:  # noqa: BLE001 - locality is optional
+                return
+
     def _on_store_miss(self, seq, base, n, ident) -> None:
         """A worker could not resolve this chunk's refs (store down,
         object evicted unspilled, injected chaos): resend the chunk
@@ -1503,6 +1628,7 @@ class Pool:
             "queue_depth": self._taskq.qsize(),
             "outstanding": self._store.outstanding(),
             "workers": len(self._workers),
+            "sched": self._sched.snapshot(),
         }
 
     def metrics(self) -> Dict[str, dict]:
@@ -1532,6 +1658,7 @@ class Pool:
         callback: Optional[Callable] = None,
         error_callback: Optional[Callable] = None,
         single: bool = False,
+        priority: float = 1.0,
     ) -> AsyncResult:
         if self._closed or self._terminated:
             raise ValueError("Pool not running")
@@ -1543,6 +1670,12 @@ class Pool:
                                   callback, error_callback)
         if not items:
             return result
+        # Scheduler registration before any chunk is queued: priority is
+        # the WDRR weight across concurrently active maps; the map's
+        # state (queued duplicates included) is dropped at completion.
+        self._sched.register_map(seq, priority)
+        self._store.add_callback(
+            seq, lambda: self._sched.release_map(seq))
         if chunksize is None:
             # Ceil division (multiprocessing's formula): floor leaves a
             # remainder chunk that lands as one worker's straggler tail —
@@ -1581,8 +1714,17 @@ class Pool:
                 if seq_digests:
                     self._arm_store_fallback(seq, digest, blob, star,
                                              items, seq_digests, tctx)
-            for base in range(0, len(enc_items), chunksize):
-                chunk = enc_items[base:base + chunksize]
+                    # Locality seed: this host's store owns the refs,
+                    # and the backend may know other hosts that already
+                    # cache them (prestaged via put_object).
+                    self._sched.note_host_has(local_host_key(),
+                                              seq_digests)
+                    self._probe_ref_locations(seq_digests)
+            for base, size in _chunk_spans(len(enc_items), chunksize):
+                chunk = enc_items[base:base + size]
+                digs = _chunk_digests(chunk)
+                if digs:
+                    self._sched.register_chunk((seq, base), digs)
                 payload = serialization.dumps(
                     ("task", seq, base, digest, blob, chunk, star, tctx)
                 )
@@ -1609,13 +1751,15 @@ class Pool:
         kwds: Optional[Dict] = None,
         callback: Optional[Callable] = None,
         error_callback: Optional[Callable] = None,
+        priority: float = 1.0,
     ) -> AsyncResult:
         if kwds:
             import functools
 
             func = functools.partial(func, **kwds)
         return self._submit(func, [tuple(args)], 1, True,
-                            callback, error_callback, single=True)
+                            callback, error_callback, single=True,
+                            priority=priority)
 
     def _device_dispatch(
         self, func: Callable, items: List[Any], star: bool
@@ -1648,7 +1792,7 @@ class Pool:
         return device_map(func, items, star=star)
 
     def _dispatch_async(self, func, items, star, chunksize,
-                        callback, error_callback):
+                        callback, error_callback, priority=1.0):
         """Device-or-host submission shared by every map variant, with
         async error contracts preserved on the device path (user-function
         errors reach error_callback / .get(); only pool-state errors
@@ -1663,7 +1807,8 @@ class Pool:
         (MAX_INFLIGHT_TASKS) or worker-start escalation."""
         if not self._wants_device(func):
             return self._submit(func, items, chunksize, star,
-                                callback, error_callback)
+                                callback, error_callback,
+                                priority=priority)
         store = ResultStore()
         seq = store.add(len(items))
         result = AsyncResult(store, seq, single=False)
@@ -1695,8 +1840,10 @@ class Pool:
         func: Callable,
         iterable: Iterable[Any],
         chunksize: Optional[int] = None,
+        priority: float = 1.0,
     ) -> List[Any]:
-        return self.map_async(func, iterable, chunksize).get()
+        return self.map_async(func, iterable, chunksize,
+                              priority=priority).get()
 
     def map_async(
         self,
@@ -1705,17 +1852,20 @@ class Pool:
         chunksize: Optional[int] = None,
         callback: Optional[Callable] = None,
         error_callback: Optional[Callable] = None,
+        priority: float = 1.0,
     ):
         return self._dispatch_async(func, list(iterable), False, chunksize,
-                                    callback, error_callback)
+                                    callback, error_callback, priority)
 
     def starmap(
         self,
         func: Callable,
         iterable: Iterable[Tuple],
         chunksize: Optional[int] = None,
+        priority: float = 1.0,
     ) -> List[Any]:
-        return self.starmap_async(func, iterable, chunksize).get()
+        return self.starmap_async(func, iterable, chunksize,
+                                  priority=priority).get()
 
     def starmap_async(
         self,
@@ -1724,22 +1874,25 @@ class Pool:
         chunksize: Optional[int] = None,
         callback: Optional[Callable] = None,
         error_callback: Optional[Callable] = None,
+        priority: float = 1.0,
     ):
         return self._dispatch_async(func, [tuple(t) for t in iterable],
                                     True, chunksize, callback,
-                                    error_callback)
+                                    error_callback, priority)
 
     def imap(
         self,
         func: Callable,
         iterable: Iterable[Any],
         chunksize: Optional[int] = None,
+        priority: float = 1.0,
     ):
         items = list(iterable)
         device_out = self._device_dispatch(func, items, star=False)
         if device_out is not None:
             return iter(device_out)
-        res = self._submit(func, items, chunksize, False)
+        res = self._submit(func, items, chunksize, False,
+                           priority=priority)
         return _ResultIterator(self._store.iter_ordered(res._seq))
 
     def imap_unordered(
@@ -1747,12 +1900,14 @@ class Pool:
         func: Callable,
         iterable: Iterable[Any],
         chunksize: Optional[int] = None,
+        priority: float = 1.0,
     ):
         items = list(iterable)
         device_out = self._device_dispatch(func, items, star=False)
         if device_out is not None:
             return iter(device_out)
-        res = self._submit(func, items, chunksize, False)
+        res = self._submit(func, items, chunksize, False,
+                           priority=priority)
         return _ResultIterator(self._store.iter_unordered(res._seq))
 
     # -- lifecycle ---------------------------------------------------------
@@ -1828,6 +1983,7 @@ class Pool:
 
     def _shutdown_transport(self) -> None:
         self._taskq.put(None)
+        self._sched.close()
         self._task_ep.close()
         self._result_ep.close()
 
@@ -2005,6 +2161,12 @@ class ResilientPool(Pool):
         # Serve if the requester is idle (no unfinished chunks), or if
         # enough chunks remain to leave one for every worker that has
         # none. qsize() is approximate; the gate re-evaluates each turn.
+        # Health-plane placement: a requester on a suspect host is
+        # parked while healthier workers exist and work is scarce —
+        # parked requests re-evaluate every turn, so a revived host
+        # (the backend detector is non-permanent) resumes service.
+        if self._suspect_defers(ident):
+            return False
         with self._pending_lock:
             if not self._pending.get(ident):
                 return True
@@ -2041,6 +2203,7 @@ class ResilientPool(Pool):
         def serve(ident: bytes, fiber_pid: int, chan) -> None:
             """Hand the next chunk (or exit) to one cleared requester;
             re-parks nothing — the caller already passed the gate."""
+            host = self._ident_hosts.get(ident)
             item = None
             while item is None:
                 if self._terminated:
@@ -2049,7 +2212,10 @@ class ResilientPool(Pool):
                     reply_exit(chan)
                     return
                 try:
-                    item = self._taskq.get(timeout=0.5)
+                    # Scheduler handout (docs/scheduling.md): WDRR map
+                    # choice + locality scan for this requester; never
+                    # hands a worker its own chunk's speculative dup.
+                    item = self._taskq.get_for(ident, host, timeout=0.5)
                 except pyqueue.Empty:
                     continue
                 if item is None:
@@ -2077,6 +2243,9 @@ class ResilientPool(Pool):
                                  time.perf_counter() - t0)
                 _m_chunks_dispatched.inc()
                 _g_queue_depth.set(self._taskq.qsize())
+                # Service-time clock starts at the successful handout;
+                # the speculation monitor ages this entry.
+                self._sched.dispatched(key, ident, host, payload)
             except (TransportClosed, OSError):
                 # Requester died between asking and receiving; put the
                 # chunk back for the next "ready" and keep serving.
@@ -2122,7 +2291,12 @@ class ResilientPool(Pool):
             msg = serialization.loads(req)
             if msg[0] != "ready":
                 continue
-            _, ident, fiber_pid = msg
+            ident, fiber_pid = msg[1], msg[2]
+            # 3-tuple readys predate the scheduler plane; the placement
+            # host key rides as an optional 4th field (same back-compat
+            # posture as the task envelope's trace context).
+            if len(msg) > 3:
+                self._ident_hosts[ident] = msg[3]
             # A stale "ready" from a worker that was already reaped must
             # not receive (and thereby strand) a task: its pending table is
             # gone and nobody would ever resubmit the chunk. Same for an
@@ -2148,6 +2322,13 @@ class ResilientPool(Pool):
                 sync_parked()
 
     def _on_result(self, seq, base, values, ident) -> None:
+        # Scheduler bookkeeping first: the first result retires every
+        # in-flight copy of the chunk (speculation's first-result-wins;
+        # the loser's late duplicate is a no-op here and its values are
+        # deduped by ResultStore.fill) and contributes the service-time
+        # sample + organic locality knowledge.
+        self._sched.completed((seq, base), ident,
+                              self._ident_hosts.get(ident))
         with self._pending_lock:
             table = self._pending.get(ident)
             if table is not None:
@@ -2176,6 +2357,7 @@ class ResilientPool(Pool):
         couldn't resolve (dedup would absorb it, but the doomed handout
         would burn a fetch cycle). New chunks can clear parked
         requests' reservation gates — nudge the handout loop."""
+        self._sched.abandon((seq, base), ident)
         with self._pending_lock:
             table = self._pending.get(ident)
             if table is not None:
@@ -2198,6 +2380,12 @@ class ResilientPool(Pool):
             # post-mortem-suspect this ident, and late beats from a
             # not-actually-dead declaree must not resurrect it.
             self._detector.forget(ident)
+        # Scheduler: the dead ident's chunk copies stop aging (their
+        # payloads re-enter the queue below; a copy whose chunk already
+        # completed — e.g. a speculation winner beat the death — is
+        # dropped at put() instead of burning another worker).
+        self._sched.abandon_ident(ident)
+        self._ident_hosts.pop(ident, None)
         with self._pending_lock:
             self._mark_ident_dead(ident)
             table = self._pending.pop(ident, {})

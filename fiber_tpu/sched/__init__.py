@@ -1,0 +1,24 @@
+"""fiber_tpu.sched — the adaptive scheduler plane.
+
+Replaces the pool's implicit FIFO handout with an explicit per-pool
+:class:`Scheduler` making three decisions — locality-aware placement,
+straggler speculation, and weighted-fair multi-map queueing — built on
+the signals the other planes already export: store locality
+(fiber_tpu/store + host-agent ``store_has``), health suspicion
+(fiber_tpu/health / the tpu backend's detector), and the telemetry
+plane's chunk-duration histogram. See docs/scheduling.md for the
+policies, knobs (``sched_policy``, ``locality_enabled``,
+``speculation_enabled``, ``speculation_quantile``) and failure
+semantics.
+"""
+
+from __future__ import annotations
+
+from fiber_tpu.sched.core import (  # noqa: F401
+    LOCALITY_SCAN,
+    SPEC_MIN_AGE,
+    SPEC_MIN_SAMPLES,
+    Scheduler,
+    local_host_key,
+    snapshots,
+)

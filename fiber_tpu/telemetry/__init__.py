@@ -101,6 +101,16 @@ def snapshot() -> Dict[str, Any]:
     host agent's ``telemetry_snapshot`` op and of ``cluster_metrics``."""
     from fiber_tpu.utils.profiling import global_timer
 
+    try:
+        # Scheduler plane (docs/scheduling.md): per-pool queue depths,
+        # per-host in-flight chunk counts and decision totals for every
+        # live scheduler in this process (empty for agents without
+        # pools).
+        from fiber_tpu import sched as _sched
+
+        sched_snaps = _sched.snapshots()
+    except Exception:  # pragma: no cover - snapshot must never fail
+        sched_snaps = []
     return {
         "host": host_id(),
         "pid": os.getpid(),
@@ -110,6 +120,7 @@ def snapshot() -> Dict[str, Any]:
         "timers": global_timer.stats(),
         "spans_buffered": len(SPANS),
         "spans_dropped": SPANS.dropped,
+        "sched": sched_snaps,
     }
 
 

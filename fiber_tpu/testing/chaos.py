@@ -35,6 +35,12 @@ kill_after_chunks     pool worker ``os._exit``\\ s after completing its
 hang_after_chunks     pool worker freezes (compute stalls AND heartbeats
                       stop) for ``hang_s`` seconds when about to run its
                       N-th chunk (budget ``hang_times``) — a hung host
+slow_worker_after_chunks  pool worker turns into a STRAGGLER from its
+                      N-th chunk on: every later chunk sleeps
+                      ``slow_worker_s`` first, heartbeats keep flowing
+                      (budget ``slow_worker_times``) — a degraded host
+                      the failure detector must NOT declare dead but
+                      the scheduler's speculation should route around
 fail_local_spawn      LocalBackend.create_job raises (budget) — spawn
                       failure burst at the backend boundary
 fail_launch           JobLauncher raises before create_job (budget)
@@ -78,12 +84,14 @@ FAIL_SITES = ("local_spawn", "launch", "agent_spawn", "store_fetch")
 _INT_FIELDS = (
     "seed", "kill_after_chunks", "kill_times",
     "hang_after_chunks", "hang_times",
+    "slow_worker_after_chunks", "slow_worker_times",
     "fail_local_spawn", "fail_launch", "fail_agent_spawn",
     "fail_store_fetch", "slow_store_every",
     "stall_recv_after", "stall_recv_times",
     "drop_recv_every", "send_delay_every",
 )
-_FLOAT_FIELDS = ("hang_s", "stall_recv_s", "send_delay_s", "slow_store_s")
+_FLOAT_FIELDS = ("hang_s", "slow_worker_s", "stall_recv_s",
+                 "send_delay_s", "slow_store_s")
 
 
 class ChaosError(RuntimeError):
@@ -99,6 +107,9 @@ class ChaosPlan:
                  kill_after_chunks: int = 0, kill_times: int = 1,
                  hang_after_chunks: int = 0, hang_s: float = 3.0,
                  hang_times: int = 1,
+                 slow_worker_after_chunks: int = 0,
+                 slow_worker_s: float = 1.0,
+                 slow_worker_times: int = 1,
                  fail_local_spawn: int = 0, fail_launch: int = 0,
                  fail_agent_spawn: int = 0,
                  fail_store_fetch: int = 0,
@@ -116,6 +127,9 @@ class ChaosPlan:
         self.hang_after_chunks = int(hang_after_chunks)
         self.hang_s = float(hang_s)
         self.hang_times = int(hang_times)
+        self.slow_worker_after_chunks = int(slow_worker_after_chunks)
+        self.slow_worker_s = float(slow_worker_s)
+        self.slow_worker_times = int(slow_worker_times)
         self.fail_local_spawn = int(fail_local_spawn)
         self.fail_launch = int(fail_launch)
         self.fail_agent_spawn = int(fail_agent_spawn)
@@ -133,6 +147,7 @@ class ChaosPlan:
         self._hang_until = 0.0
         self._send_count = 0
         self._store_gets = 0
+        self._slow = False  # this process claimed a slow-worker token
 
     # -- spec (env) form ------------------------------------------------
     @classmethod
@@ -217,6 +232,22 @@ class ChaosPlan:
             with self._lock:
                 self._hang_until = time.monotonic() + self.hang_s
             time.sleep(self.hang_s)
+
+    def maybe_slow_worker(self, completed_chunks: int) -> None:
+        """pool worker, before running a chunk: once this worker claims
+        a slow token (at its ``slow_worker_after_chunks``-th chunk) it
+        stays a straggler for life — every subsequent chunk sleeps
+        ``slow_worker_s`` first while heartbeats keep flowing. Models a
+        degraded-but-alive host: the failure detector must not fire,
+        the scheduler's speculation path is what's under test."""
+        if not self.slow_worker_after_chunks:
+            return
+        if (not self._slow
+                and completed_chunks >= self.slow_worker_after_chunks
+                and self.acquire("slow", self.slow_worker_times)):
+            self._slow = True
+        if self._slow:
+            time.sleep(self.slow_worker_s)
 
     def heartbeats_allowed(self) -> bool:
         with self._lock:

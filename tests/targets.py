@@ -16,6 +16,14 @@ def sleep_for(seconds: float) -> None:
     time.sleep(seconds)
 
 
+def sleep_echo(x):
+    """Small fixed-cost task returning its input — the scheduler-plane
+    tests' unit of work (idempotent AND side-effect free, so straggler
+    speculation may duplicate it)."""
+    time.sleep(0.05)
+    return x
+
+
 def sleep_forever() -> None:
     while True:
         time.sleep(3600)

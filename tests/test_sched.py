@@ -1,0 +1,382 @@
+"""Scheduler plane (docs/scheduling.md): balanced chunking, WDRR
+fairness ratios, locality preference with a seeded store map, straggler
+speculation trigger math, suspect-host deferral, and the chaos claims —
+a seeded straggler is speculated, exactly one result per task is
+delivered (racing the original AND composing with death-resubmit), and
+trace ids survive speculation (the resubmit envelope-reuse rule)."""
+
+import os
+import queue as pyqueue
+import time
+
+import pytest
+
+import fiber_tpu
+from fiber_tpu import telemetry
+from fiber_tpu.pool import _chunk_spans
+from fiber_tpu.sched import SPEC_MIN_SAMPLES, Scheduler
+from fiber_tpu.telemetry import tracing
+from fiber_tpu.testing import chaos
+from tests import targets
+
+W1, W2, W3 = b"worker-1", b"worker-2", b"worker-3"
+
+
+@pytest.fixture(autouse=True)
+def _sched_isolation():
+    """Each test starts with an empty span buffer and ends with config
+    overrides (speculation knobs, policies) dropped."""
+    tracing.SPANS.clear()
+    yield
+    fiber_tpu.init()
+
+
+def _mk(key, payload=b"p"):
+    return (payload, key)
+
+
+def _drain_for(sched, ident, host, n):
+    got = []
+    for _ in range(n):
+        got.append(sched.get_for(ident, host, timeout=0.05))
+    return got
+
+
+# ---------------------------------------------------------------------------
+# balanced remainder chunking
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [1, 2, 7, 31, 33, 63, 100, 101, 5000])
+def test_balanced_chunk_spans_odd_lengths(n):
+    chunksize = 32
+    spans = _chunk_spans(n, chunksize)
+    sizes = [size for _, size in spans]
+    # covers every item exactly once, contiguously
+    assert sum(sizes) == n
+    assert spans[0][0] == 0
+    for (base, size), (next_base, _) in zip(spans, spans[1:]):
+        assert next_base == base + size
+    # explicit chunksize stays a CAP, and the remainder is balanced:
+    # no tiny straggler tail, sizes within 1 of each other
+    assert max(sizes) <= chunksize
+    assert max(sizes) - min(sizes) <= 1
+    assert len(spans) == -(-n // chunksize)
+
+
+def test_balanced_chunking_divisible_length_unchanged():
+    # Evenly divisible lengths keep the classic fixed-size chunks (the
+    # telemetry suite counts exactly 16 worker.execute spans at 64/4).
+    assert _chunk_spans(64, 4) == [(i * 4, 4) for i in range(16)]
+
+
+# ---------------------------------------------------------------------------
+# WDRR fairness
+# ---------------------------------------------------------------------------
+
+
+def test_wdrr_fairness_ratio():
+    """Two active maps at priorities 3:1 are served 3:1 — the low-weight
+    map is never starved, the high-weight one never monopolizes."""
+    sched = Scheduler(n_workers=4)
+    sched.register_map(1, priority=1.0)
+    sched.register_map(2, priority=3.0)
+    for i in range(40):
+        sched.put(_mk((1, i)))
+    for i in range(40):
+        sched.put(_mk((2, i)))
+    served = [sched.get(timeout=0.05)[1][0] for _ in range(40)]
+    # exact WDRR ratio over full cycles: every window of 4 serves 3
+    # chunks of map 2 and 1 of map 1
+    assert served.count(2) == 30
+    assert served.count(1) == 10
+    for w in range(0, 40, 4):
+        assert served[w:w + 4].count(2) == 3
+    assert sched.decisions["fair"] > 0
+
+
+def test_fifo_policy_is_strict_arrival_order():
+    sched = Scheduler(n_workers=4, policy="fifo")
+    sched.register_map(1, priority=1.0)
+    sched.register_map(2, priority=100.0)  # ignored by fifo
+    order = [(1, 0), (2, 0), (1, 1), (2, 1)]
+    for key in order:
+        sched.put(_mk(key))
+    assert [sched.get(timeout=0.05)[1] for _ in order] == order
+    with pytest.raises(pyqueue.Empty):
+        sched.get(timeout=0.01)
+
+
+# ---------------------------------------------------------------------------
+# locality placement
+# ---------------------------------------------------------------------------
+
+
+def test_locality_prefers_seeded_host():
+    """A chunk whose refs are pre-seeded on one host is routed to that
+    host's requester ahead of queue order; a requester elsewhere gets
+    the plain head — asserted via the sched_decisions counter."""
+    before = telemetry.REGISTRY.snapshot().get(
+        "sched_decisions", {}).get("series", {}).get("kind=locality", 0)
+    sched = Scheduler(n_workers=2)
+    sched.register_map(5, priority=1.0)
+    sched.register_chunk((5, 1), ["digest-a"])
+    sched.register_chunk((5, 3), ["digest-a"])
+    for i in range(4):
+        sched.put(_mk((5, i)))
+    sched.note_host_has("hostB", ["digest-a"])
+    # hostB's worker jumps the queue to the ref-bearing chunk...
+    assert sched.get_for(W2, "hostB", timeout=0.05)[1] == (5, 1)
+    # ...hostA's worker takes the plain head
+    assert sched.get_for(W1, "hostA", timeout=0.05)[1] == (5, 0)
+    assert sched.get_for(W2, "hostB", timeout=0.05)[1] == (5, 3)
+    assert sched.get_for(W1, "hostA", timeout=0.05)[1] == (5, 2)
+    assert sched.decisions["locality"] == 2
+    after = telemetry.REGISTRY.snapshot()[
+        "sched_decisions"]["series"].get("kind=locality", 0)
+    assert after - before >= 2
+
+
+def test_completion_teaches_locality():
+    """A completed ref-bearing chunk marks the completing host as
+    holding those objects (its store tier now caches them)."""
+    sched = Scheduler(n_workers=2)
+    sched.register_map(1, priority=1.0)
+    sched.register_chunk((1, 0), ["dig-x"])
+    sched.register_chunk((1, 2), ["dig-x"])
+    sched.put(_mk((1, 0)))
+    item = sched.get_for(W1, "hostA", timeout=0.05)
+    sched.dispatched(item[1], W1, "hostA", item[0])
+    sched.completed(item[1], W1, "hostA")
+    # hostA now attracts the sibling chunk over the queue head
+    sched.put(_mk((1, 1)))
+    sched.put(_mk((1, 2)))
+    assert sched.get_for(W1, "hostA", timeout=0.05)[1] == (1, 2)
+
+
+# ---------------------------------------------------------------------------
+# speculation trigger math
+# ---------------------------------------------------------------------------
+
+
+def _feed_fast_samples(sched, seq, n=SPEC_MIN_SAMPLES):
+    """Run n instant chunks through dispatch->complete so the map has a
+    (tiny) median service time."""
+    for i in range(100, 100 + n):
+        key = (seq, i)
+        sched.put(_mk(key))
+        item = sched.get_for(W1, "hostA", timeout=0.05)
+        sched.dispatched(item[1], W1, "hostA", item[0])
+        sched.completed(item[1], W1, "hostA")
+
+
+def test_speculation_triggers_and_self_skip():
+    sched = Scheduler(n_workers=2, speculation=False,
+                      speculation_quantile=2.0)
+    sched.speculation = True  # monitor thread off; tick manually
+    sched.register_map(1, priority=1.0)
+    _feed_fast_samples(sched, 1)
+    sched.put(_mk((1, 0), payload=b"orig"))
+    item = sched.get_for(W1, "hostA", timeout=0.05)
+    sched.dispatched(item[1], W1, "hostA", item[0])
+    # age must exceed max(quantile * median, SPEC_MIN_AGE=0.05)
+    assert sched.speculate_once() == 0  # too young yet
+    time.sleep(0.08)
+    assert sched.speculate_once() == 1
+    assert sched.decisions["speculate"] == 1
+    # the duplicate must not go back to its own holder...
+    with pytest.raises(pyqueue.Empty):
+        sched.get_for(W1, "hostA", timeout=0.01)
+    # ...a different worker takes it, SAME payload bytes (envelope
+    # reuse: trace ids survive speculation by construction)
+    dup = sched.get_for(W2, "hostB", timeout=0.05)
+    assert dup == (b"orig", (1, 0))
+    # each chunk speculates at most once
+    time.sleep(0.06)
+    assert sched.speculate_once() == 0
+
+
+def test_speculation_needs_idle_workers_and_empty_queue():
+    sched = Scheduler(n_workers=1, speculation=False,
+                      speculation_quantile=2.0)
+    sched.speculation = True
+    sched.register_map(1, priority=1.0)
+    _feed_fast_samples(sched, 1)
+    sched.put(_mk((1, 0)))
+    item = sched.get_for(W1, "hostA", timeout=0.05)
+    sched.dispatched(item[1], W1, "hostA", item[0])
+    time.sleep(0.08)
+    # the only worker is busy holding the chunk: nobody to speculate on
+    assert sched.speculate_once() == 0
+    sched2 = Scheduler(n_workers=4, speculation=False,
+                       speculation_quantile=2.0)
+    sched2.speculation = True
+    sched2.register_map(1, priority=1.0)
+    _feed_fast_samples(sched2, 1)
+    sched2.put(_mk((1, 0)))
+    item = sched2.get_for(W1, "hostA", timeout=0.05)
+    sched2.dispatched(item[1], W1, "hostA", item[0])
+    sched2.put(_mk((1, 1)))  # queue not drained: no speculation yet
+    time.sleep(0.08)
+    assert sched2.speculate_once() == 0
+
+
+def test_completed_chunk_requeue_is_dropped():
+    """A death-resubmit of a chunk the speculation winner already
+    completed must not burn another worker (the put is dropped)."""
+    sched = Scheduler(n_workers=2)
+    sched.register_map(1, priority=1.0)
+    sched.put(_mk((1, 0)))
+    item = sched.get_for(W1, "hostA", timeout=0.05)
+    sched.dispatched(item[1], W1, "hostA", item[0])
+    sched.completed(item[1], W1, "hostA")
+    sched.put(item)  # the loser's reclaim re-queues it
+    assert sched.qsize() == 0
+    with pytest.raises(pyqueue.Empty):
+        sched.get_for(W2, "hostB", timeout=0.01)
+
+
+# ---------------------------------------------------------------------------
+# suspect-host deferral (pool gate)
+# ---------------------------------------------------------------------------
+
+
+def test_suspect_host_requests_deferred():
+    pool = fiber_tpu.Pool(2)
+    try:
+        pool._host_suspect_fn = lambda h: h == "bad-host"
+        pool._ident_hosts = {W1: "bad-host", W2: "good-host"}
+        assert pool._suspect_defers(W1) is True
+        assert pool._suspect_defers(W2) is False
+        # with EVERY host suspect, serving beats a placement deadlock
+        pool._ident_hosts = {W1: "bad-host", W2: "bad-host"}
+        assert pool._suspect_defers(W1) is False
+    finally:
+        pool.terminate()
+
+
+# ---------------------------------------------------------------------------
+# pool integration: fairness, locality counters, priority API
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_maps_interleave_and_priority_api():
+    """Two concurrently active maps both complete correctly and the
+    scheduler records fair-queueing decisions; priority= is accepted by
+    every map variant."""
+    with fiber_tpu.Pool(2) as pool:
+        big = pool.map_async(targets.square, range(200), chunksize=2,
+                             priority=1.0)
+        small = pool.map_async(targets.square, range(20), chunksize=2,
+                               priority=8.0)
+        assert small.get(60) == [x * x for x in range(20)]
+        assert big.get(60) == [x * x for x in range(200)]
+        stats = pool.stats()["sched"]
+        assert stats["policy"] == "adaptive"
+        assert stats["decisions"]["fair"] > 0
+        # the other variants accept priority= too
+        assert pool.starmap(targets.add, [(1, 2)], priority=2.0) == [3]
+        assert list(pool.imap(targets.square, [3], priority=2.0)) == [9]
+        assert pool.apply_async(targets.square, (4,),
+                                priority=2.0).get(30) == 16
+
+
+def test_locality_counters_broadcast_map():
+    """Acceptance: a map whose broadcast payload travels by reference
+    routes its chunks as locality decisions (the workers' host already
+    caches the object after the first fetch — master-seeded), pinned by
+    sched_decisions{kind=locality} AND the store wire counters (one
+    transfer per host, the objectstore proof style)."""
+    import numpy as np
+
+    fiber_tpu.init()
+    with fiber_tpu.Pool(2) as pool:
+        arr = np.arange((2 << 20) // 8, dtype=np.float64)  # 2 MB
+        before = pool.store_stats()
+        out = pool.starmap(targets.arr_sum_plus,
+                           [(arr, i) for i in range(24)], chunksize=2)
+        assert out == [float(arr.sum()) + i for i in range(24)]
+        after = pool.store_stats()
+        sched = pool.stats()["sched"]
+    assert sched["decisions"]["locality"] > 0
+    # one wire transfer per HOST, not per task (both workers share the
+    # host cache tier)
+    wire_tx = after["wire_bytes_tx"] - before.get("wire_bytes_tx", 0)
+    assert arr.nbytes <= wire_tx < 2 * arr.nbytes
+
+
+def test_sched_snapshot_rides_telemetry():
+    with fiber_tpu.Pool(2) as pool:
+        pool.map(targets.square, range(8))
+        snaps = telemetry.snapshot()["sched"]
+        assert any(s["policy"] == "adaptive" for s in snaps)
+        hist = telemetry.REGISTRY.snapshot()["pool_chunk_duration_seconds"]
+        assert hist["series"][""][-1] >= 1  # observations recorded
+
+
+# ---------------------------------------------------------------------------
+# chaos: straggler speculation end to end
+# ---------------------------------------------------------------------------
+
+
+def test_straggler_speculated_one_result_per_task(tmp_path):
+    """A chaos-slowed worker (alive, heartbeating, just slow) holds
+    chunks; the scheduler speculates duplicates onto idle workers;
+    exactly one result per task reaches the consumer and every worker
+    span — original and speculative — carries the map's ONE trace id
+    (the duplicate reuses the envelope, the resubmit rule)."""
+    seed = int(os.environ.get("FIBER_CHAOS_SEED", "11"))
+    plan = chaos.install(chaos.ChaosPlan(
+        seed=seed, token_dir=str(tmp_path / "tokens"),
+        slow_worker_after_chunks=1, slow_worker_s=2.0,
+        slow_worker_times=1))
+    try:
+        fiber_tpu.init(speculation_enabled=True,
+                       speculation_quantile=2.0,
+                       trace_sample_rate=1.0)
+        with fiber_tpu.Pool(4) as pool:
+            xs = list(range(24))
+            out = pool.map(targets.sleep_echo, xs, chunksize=1)
+            assert out == xs              # one result per task, in order
+            assert len(out) == len(xs)
+            sched = pool.stats()["sched"]
+    finally:
+        chaos.uninstall()
+        fiber_tpu.init()
+    assert plan.spent("slow") == 1
+    assert sched["decisions"]["speculate"] >= 1
+    serialize = [s for s in tracing.SPANS.snapshot()
+                 if s["name"] == "pool.serialize"]
+    execute = [s for s in tracing.SPANS.snapshot()
+               if s["name"] == "worker.execute"]
+    assert len(serialize) == 1
+    assert len(execute) >= len(xs)  # duplicates may add spans...
+    # ...but every one of them rides the map's single trace
+    assert {s["trace"] for s in execute} == {serialize[0]["trace"]}
+
+
+def test_speculation_composes_with_death_resubmit(tmp_path):
+    """Kill a worker mid-map WHILE a straggler is being speculated: the
+    death-resubmit and speculation paths share the dedup-on-fill
+    contract, so the map still delivers exactly one result per task."""
+    seed = int(os.environ.get("FIBER_CHAOS_SEED", "13"))
+    plan = chaos.install(chaos.ChaosPlan(
+        seed=seed, token_dir=str(tmp_path / "tokens"),
+        slow_worker_after_chunks=1, slow_worker_s=2.5,
+        slow_worker_times=1,
+        kill_after_chunks=3, kill_times=1))
+    try:
+        fiber_tpu.init(speculation_enabled=True,
+                       speculation_quantile=2.0)
+        with fiber_tpu.Pool(4) as pool:
+            xs = list(range(30))
+            out = pool.map(targets.sleep_echo, xs, chunksize=1)
+            assert out == xs
+            stats = pool.stats()
+    finally:
+        chaos.uninstall()
+        fiber_tpu.init()
+    assert plan.spent("kill") == 1
+    assert plan.spent("slow") == 1
+    assert stats["chunks_resubmitted"] >= 1
+    assert stats["sched"]["decisions"]["speculate"] >= 1

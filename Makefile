@@ -4,7 +4,7 @@
 PYTEST ?= python -m pytest tests/ -q
 
 .PHONY: test stest test-all lint bench bench-store bench-telemetry \
-	bench-sched weakscale docs chaos
+	bench-sched bench-transport weakscale docs chaos
 
 # Tier 1: local backend (subprocess jobs)
 test:
@@ -63,6 +63,15 @@ bench-telemetry:
 bench-sched:
 	JAX_PLATFORMS=cpu python bench.py --sched > BENCH_sched.json; \
 	rc=$$?; cat BENCH_sched.json; exit $$rc
+
+# Transport I/O-core gate (docs/transport.md): selector event loop vs
+# thread-per-connection on small-frame frames/sec (must be >= 1.5x),
+# large-frame throughput (must stay >= 0.95x) and a 64-worker fan-in
+# (CPU seconds + transport thread count). The record lands in
+# BENCH_transport.json either way.
+bench-transport:
+	JAX_PLATFORMS=cpu python bench.py --transport > BENCH_transport.json; \
+	rc=$$?; cat BENCH_transport.json; exit $$rc
 
 # Weak-scaling record over 1/2/4/8-device sim meshes (fused ES,
 # population scaled with devices) + strong curve (constant total pop)

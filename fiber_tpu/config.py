@@ -75,6 +75,26 @@ DEFAULTS: Dict[str, Any] = {
     "speculation_quantile": 4.0,
     # --- data plane ---
     "use_push_queue": True,
+    # --- transport I/O core (docs/transport.md) ---
+    # "selector": one selectors-driven poller thread per process owns
+    # every channel socket — non-blocking incremental frame decode,
+    # scatter-gather (sendmsg) sends, small-frame coalescing; socket
+    # threads are O(1) in connection count. "threads": the blocking
+    # thread-per-connection fallback (one reader thread per channel).
+    "transport_io": "selector",
+    # Upper bound on bytes the selector loop gathers into one coalesced
+    # sendmsg flush; small control frames (credit, hb, spans, storemiss)
+    # queued between poller wakeups leave in a single syscall up to this
+    # size. Large payloads are never split — a frame bigger than the cap
+    # still goes out as one vectored send.
+    "transport_coalesce_max": 256 * 1024,
+    # Standing credit window a bound r-endpoint grants each peer (fan-in
+    # ingress like pool result streams): how many frames a sender may
+    # run ahead of the consumer. Large enough to never throttle by
+    # default; lower it to bound per-peer master memory (window x frame
+    # size) — bench.py --transport also lowers it to pace its pushers
+    # into a steady stream.
+    "transport_credit_window": 4096,
     # --- object store (docs/objectstore.md) ---
     # By-reference task data plane: pool args/results whose serialized
     # size exceeds store_inline_max bytes travel as ObjectRefs through

@@ -6,36 +6,101 @@ for everything (the reference uses three: nanomsg's own, raw struct-packed
 admin messages, and multiprocessing.connection — fiber/socket.py,
 fiber/popen_fiber_spawn.py:56-72, fiber/managers.py:26-31; unifying them is
 deliberate simplification).
+
+Two decode surfaces (docs/transport.md):
+
+* :func:`recv_frame` — one-shot blocking read on a raw socket, for
+  sequential protocol exchanges (auth handshake, spawn bootstrap, ring
+  collectives) where buffering ahead would steal bytes from the next
+  protocol layer;
+* :class:`FrameBuffer` / :class:`FrameReader` — incremental decode from a
+  per-connection receive buffer, for long-lived channels: the 8-byte
+  length prefix no longer costs its own ``recv_into`` round, so a tiny
+  frame needs ONE syscall and a burst of tiny frames arriving together
+  needs one syscall *total*. Large frames switch to a preallocated
+  buffer filled with ``recv_into`` directly (no append-and-slice copy).
 """
 
 from __future__ import annotations
 
 import socket
 import struct
-from typing import Optional
+from typing import List, Optional, Union
 
 _LEN = struct.Struct(">Q")
 
 #: Sanity ceiling for one frame (1 TiB) — catches corrupted streams early.
 MAX_FRAME = 1 << 40
 
+#: Payloads above this are sent vectored (scatter-gather) instead of being
+#: concatenated with the header — one syscall either way, zero large copies.
+SMALL_FRAME_MAX = 65536
+
+_HAVE_SENDMSG = hasattr(socket.socket, "sendmsg")
+
+
+def pack_header(length: int) -> bytes:
+    """The 8-byte length prefix for a frame body of ``length`` bytes —
+    exposed so callers that queue frames ahead of the flush (the
+    selector loop's write path) pack it once at enqueue."""
+    return _LEN.pack(length)
+
 
 class ConnectionClosed(OSError):
     """Peer closed the connection mid-frame or before a frame."""
 
 
-def send_frame(sock: socket.socket, payload, prefix: bytes = b"") -> None:
+def sendmsg_all(sock: socket.socket, buffers) -> int:
+    """Vectored (scatter-gather) send of every buffer in ``buffers``,
+    looping on partial writes — ``sendall`` semantics for an iovec.
+    Unlike ``sendall``, ``sendmsg`` may accept only part of the vector
+    in one call (and always may on a non-blocking socket), so the tail
+    is re-sent with memoryview slices — never copied. Returns the total
+    byte count."""
+    bufs: List[memoryview] = [
+        m for m in (memoryview(b) for b in buffers) if m.nbytes
+    ]
+    total = sum(m.nbytes for m in bufs)
+    done = 0
+    while bufs:
+        sent = sock.sendmsg(bufs)
+        done += sent
+        if done >= total:
+            break
+        while bufs and sent >= bufs[0].nbytes:
+            sent -= bufs[0].nbytes
+            bufs.pop(0)
+        if sent:
+            bufs[0] = bufs[0][sent:]
+    return done
+
+
+def send_frame(sock: socket.socket, payload, prefix: bytes = b"",
+               header: Optional[bytes] = None) -> None:
     """Send one frame; ``prefix`` rides inside the frame before the payload
     (used by the transport for its 1-byte frame-type tag) without copying
     large payloads. ``payload`` may be any bytes-like (the object-store
-    plane streams memoryview slices)."""
-    header = _LEN.pack(len(payload) + len(prefix))
-    if len(payload) > 65536:
-        # Avoid duplicating large payloads (host-plane tensors) in memory.
-        sock.sendall(header + prefix)
-        sock.sendall(payload)
+    plane streams memoryview slices). ``header`` lets a caller that has
+    already packed the 8-byte length prefix (the event loop's write queue
+    builds frames ahead of the flush) hand it in instead of re-packing."""
+    if header is None:
+        header = _LEN.pack(len(payload) + len(prefix))
+    if len(payload) > SMALL_FRAME_MAX:
+        # Large path: one vectored syscall, zero payload copies (the old
+        # shape was two sendall syscalls; header+payload in separate
+        # TCP segments also cost the peer an extra wakeup).
+        if _HAVE_SENDMSG:
+            sendmsg_all(sock, (header, prefix, payload))
+        else:  # pragma: no cover - platforms without sendmsg
+            sock.sendall(header + prefix)
+            sock.sendall(payload)
     else:
-        sock.sendall(header + prefix + bytes(payload))
+        # Small path: concatenate once so the frame leaves in one
+        # segment. bytes/bytearray concatenate directly — only exotic
+        # bytes-likes (memoryview slices) need materializing first.
+        if not isinstance(payload, (bytes, bytearray)):
+            payload = bytes(payload)
+        sock.sendall(header + prefix + payload)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytearray:
@@ -66,6 +131,120 @@ def recv_frame(sock: socket.socket) -> bytearray:
     if length == 0:
         return bytearray()
     return _recv_exact(sock, length)
+
+
+class FrameBuffer:
+    """Incremental frame decoder over an internal receive buffer.
+
+    Feed it with :meth:`fill` (one ``recv`` against the socket — blocking
+    or not is the socket's business) and drain completed frames with
+    :meth:`pop`. Small frames are sliced out of the shared buffer; a
+    frame whose length crosses :data:`LARGE_DIRECT` switches to a
+    dedicated preallocated bytearray that later fills ``recv_into``
+    directly — large payloads are written by the kernel exactly once.
+    """
+
+    #: One recv per readiness event pulls up to this much.
+    RECV_CHUNK = 256 * 1024
+    #: Frames at least this long bypass the append buffer.
+    LARGE_DIRECT = 64 * 1024
+
+    __slots__ = ("_buf", "_pos", "_big", "_big_view", "_big_got")
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+        self._pos = 0  # parse offset into _buf (compacted in fill)
+        self._big: Optional[bytearray] = None
+        self._big_view: Optional[memoryview] = None
+        self._big_got = 0
+
+    def fill(self, sock: socket.socket) -> int:
+        """One receive into the decode state. Returns the byte count
+        (0 = EOF). Propagates ``BlockingIOError`` on a non-blocking
+        socket with nothing to read."""
+        if self._big is not None and self._big_got < len(self._big):
+            n = sock.recv_into(
+                self._big_view[self._big_got:],
+                min(len(self._big) - self._big_got, 1 << 20),
+            )
+            self._big_got += n
+            return n
+        if self._pos:
+            # Compact consumed bytes once per refill (between fills any
+            # number of frames pop with a pure offset advance).
+            del self._buf[:self._pos]
+            self._pos = 0
+        data = sock.recv(self.RECV_CHUNK)
+        if not data:
+            return 0
+        self._buf += data
+        return len(data)
+
+    def pending(self) -> int:
+        """Bytes buffered but not yet returned as frames."""
+        n = len(self._buf) - self._pos
+        if self._big is not None:
+            n += self._big_got
+        return n
+
+    def pop(self) -> Optional[bytearray]:
+        """Next complete frame, or None if more bytes are needed."""
+        if self._big is not None:
+            if self._big_got < len(self._big):
+                return None
+            frame = self._big
+            self._big = self._big_view = None
+            self._big_got = 0
+            return frame
+        avail = len(self._buf) - self._pos
+        if avail < _LEN.size:
+            return None
+        (length,) = _LEN.unpack_from(self._buf, self._pos)
+        if length > MAX_FRAME:
+            raise OSError(f"frame too large: {length}")
+        if length >= self.LARGE_DIRECT:
+            # Switch to the direct path: move whatever payload is already
+            # buffered (at most RECV_CHUNK) into the dedicated buffer and
+            # recv_into the rest — the one copy is bounded and small.
+            frame = bytearray(length)
+            start = self._pos + _LEN.size
+            take = min(avail - _LEN.size, length)
+            frame[:take] = self._buf[start:start + take]
+            self._pos = start + take
+            self._big = frame
+            self._big_view = memoryview(frame)
+            self._big_got = take
+            return self.pop()
+        if avail - _LEN.size < length:
+            return None
+        start = self._pos + _LEN.size
+        # A bytearray slice IS a fresh bytearray — no second copy.
+        frame = self._buf[start:start + length]
+        self._pos = start + length
+        return frame
+
+
+class FrameReader:
+    """Blocking buffered frame reader for one long-lived socket: header
+    and payload of a tiny frame arrive in one syscall, and several frames
+    already queued in the kernel drain in one. Do NOT mix with raw
+    :func:`recv_frame` on the same socket — buffered bytes would be
+    invisible to it."""
+
+    __slots__ = ("_sock", "_fb")
+
+    def __init__(self, sock: socket.socket) -> None:
+        self._sock = sock
+        self._fb = FrameBuffer()
+
+    def recv(self) -> bytearray:
+        while True:
+            frame = self._fb.pop()
+            if frame is not None:
+                return frame
+            if self._fb.fill(self._sock) == 0:
+                raise ConnectionClosed(
+                    "connection closed while reading frame")
 
 
 def recv_frame_timeout(

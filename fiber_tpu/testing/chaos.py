@@ -261,18 +261,32 @@ class ChaosPlan:
             raise ChaosError(f"chaos: injected {site} failure "
                              f"(seed={self.seed})")
 
-    def on_recv_frame(self, chan) -> bool:
-        """Bound-``r`` ingress reader, per data frame. Returns False to
-        drop the frame; may sleep first (stall injection). Counters ride
-        the channel object so each connection has its own schedule."""
+    def recv_frame_actions(self, chan):
+        """Bound-``r`` ingress, per data frame: the fault decision WITHOUT
+        its side effect — returns ``(stall_s, drop)``. Counters ride the
+        channel object so each connection has its own schedule. Both I/O
+        modes consult this one method, so a plan's schedule is identical
+        under ``transport_io=threads`` (the reader thread sleeps
+        ``stall_s`` itself) and ``=selector`` (the poller parks the
+        channel for ``stall_s`` instead of sleeping — one stalled
+        connection must not stall every channel in the process)."""
         count = getattr(chan, "_chaos_rx", 0) + 1
         chan._chaos_rx = count
+        stall_s = 0.0
         if (self.stall_recv_after and count == self.stall_recv_after
                 and self.acquire("stall", self.stall_recv_times)):
-            time.sleep(self.stall_recv_s)
-        if self.drop_recv_every and count % self.drop_recv_every == 0:
-            return False
-        return True
+            stall_s = self.stall_recv_s
+        drop = bool(self.drop_recv_every
+                    and count % self.drop_recv_every == 0)
+        return stall_s, drop
+
+    def on_recv_frame(self, chan) -> bool:
+        """Blocking-reader form of :meth:`recv_frame_actions`: sleeps the
+        stall in place and returns False to drop the frame."""
+        stall_s, drop = self.recv_frame_actions(chan)
+        if stall_s > 0.0:
+            time.sleep(stall_s)
+        return not drop
 
     def on_send_frame(self) -> None:
         """Endpoint.send, per frame: latency injection."""

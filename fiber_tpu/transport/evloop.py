@@ -1,0 +1,519 @@
+"""Selector-based transport I/O core (docs/transport.md).
+
+One poller thread per process owns every non-blocking channel socket the
+``transport_io="selector"`` path creates:
+
+* **ingress** — readiness-driven incremental frame decode from a
+  per-channel :class:`~fiber_tpu.framing.FrameBuffer` replaces the
+  thread-per-connection blocking readers: a master driving a pod-slice's
+  worth of workers runs O(1) socket threads instead of one GIL-contending
+  thread per peer, and a burst of tiny frames queued in the kernel drains
+  in one syscall and one inbox notify;
+* **egress** — a per-channel write queue drained with
+  ``socket.sendmsg`` scatter-gather: a large frame leaves as one
+  vectored syscall (header + type tag + payload, zero copies), and small
+  control frames (credit grants, heartbeats, span batches, storemiss
+  notices) queued between poller wakeups coalesce into a single flush of
+  up to ``transport_coalesce_max`` bytes.
+
+The loop is an implementation detail behind ``Endpoint`` — recv/send,
+credit semantics, ``last_rx``, the exact byte/frame counters, and the
+chaos ingress hook behave identically to the ``"threads"`` fallback
+(tested: tests/test_transport.py parity suite, tests/test_chaos.py drop
+plans under both modes). The design is the standard event-loop +
+vectored-I/O shape of Ray's raylet and gRPC's polling engine.
+
+Threading rules:
+
+* every selector mutation (register/modify/unregister/close) happens on
+  the loop thread; other threads submit ops through ``_pending`` and
+  :meth:`wake` — epoll tolerates concurrent ctl calls but the selectors
+  bookkeeping does not;
+* sender threads only touch a channel's tx queue under its tx condition,
+  so enqueue is a few appends + at most one wake write;
+* the loop never sleeps in user hooks: a chaos-injected ingress stall
+  parks ONE channel until its deadline (select timeout), it does not
+  stall the process's whole data plane the way sleeping the poller
+  would.
+"""
+
+from __future__ import annotations
+
+import os
+import selectors
+import socket
+import threading
+import time
+from contextlib import contextmanager
+from typing import List, Optional
+
+from fiber_tpu import telemetry
+from fiber_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+# Registry twins of the transport wire counters — same instruments
+# transport/tcp.py registers (the registry folds same-name lookups), so
+# the loop can bump them once per decode batch instead of per frame.
+_m_bytes_rx = telemetry.counter(
+    "transport_bytes_rx", "Wire bytes received (framing headers included)")
+_m_frames_rx = telemetry.counter("transport_frames_rx", "Frames received")
+
+# Poller health surface (docs/transport.md / docs/observability.md).
+_m_channels = telemetry.gauge(
+    "transport_evloop_channels",
+    "Channel sockets currently owned by this process's selector loop")
+_m_wakeups = telemetry.counter(
+    "transport_evloop_wakeups", "Selector loop select() returns")
+_m_flush_frames = telemetry.histogram(
+    "transport_evloop_flush_frames",
+    "Whole frames completed per coalesced sendmsg flush",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+_m_flush_bytes = telemetry.histogram(
+    "transport_evloop_flush_bytes",
+    "Bytes accepted by the kernel per sendmsg flush",
+    buckets=(64, 1024, 16384, 65536, 262144, 1 << 20, 8 << 20))
+
+#: iovec entries per sendmsg call; Linux UIO_MAXIOV is 1024 — stay under.
+_IOV_MAX = 512
+
+#: Per-channel write-queue high-water mark: an enqueuing sender blocks
+#: past this many pending bytes until the loop drains below it (bounds
+#: memory the way a blocking sendall's kernel-buffer wait did). A single
+#: frame is always accepted whole, so one oversized payload can't
+#: deadlock its own enqueue.
+TX_HIGH_WATER = 32 << 20
+
+
+class EventLoop:
+    """The per-process poller. Use :func:`get_loop`, not the class."""
+
+    def __init__(self) -> None:
+        self._selector = selectors.DefaultSelector()
+        self._lock = threading.Lock()
+        self._pending: List[tuple] = []   # (op, chan) submitted cross-thread
+        self._stalled: set = set()        # channels parked by chaos stalls
+        self._rx_batches: dict = {}       # endpoint -> frames this turn
+        self._hold_tx = False             # test hook: park all flushes
+        self._in_select = False           # loop is (about to be) sleeping
+        self._closed = False
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._wake_armed = False
+        self._selector.register(self._wake_r, selectors.EVENT_READ, None)
+        self._thread = threading.Thread(
+            target=self._run, name="fiber-evloop", daemon=True)
+        self._thread.start()
+
+    # -- cross-thread interface ------------------------------------------
+    def wake(self) -> None:
+        with self._lock:
+            if self._wake_armed:
+                return
+            if not self._in_select:
+                # The loop is mid-turn: it re-checks the op queue under
+                # this lock before its next sleep, so the byte (a
+                # syscall per sender) is pure waste right now.
+                return
+            self._wake_armed = True
+        try:
+            self._wake_w.send(b"\0")
+        except OSError:
+            pass
+
+    def _submit(self, op: str, chan) -> None:
+        with self._lock:
+            self._pending.append((op, chan))
+        self.wake()
+
+    def register_channel(self, chan) -> None:
+        """Adopt ``chan``'s socket (already non-blocking). Called from
+        the thread that accepted/dialed the connection."""
+        chan.sock.setblocking(False)
+        self._submit("add", chan)
+
+    def request_flush(self, chan) -> None:
+        """A sender queued data on ``chan``; schedule a drain."""
+        self._submit("tx", chan)
+
+    def close_channel(self, chan) -> None:
+        """Flush ``chan``'s queued egress best-effort, then unregister
+        and close its socket on the loop thread. Callable from any
+        thread, including the loop itself (the drop path)."""
+        on_loop = threading.current_thread() is self._thread
+        with chan._tx_cond:
+            already = chan._tx_closing
+            chan._tx_closing = True
+            chan._tx_cond.notify_all()
+            if not already and not on_loop:
+                # Caller-side synchronous drain: the worker-exit path
+                # (result sent, endpoint closed, process gone) must not
+                # race the daemon poller for its last frames. Wait out
+                # any in-flight loop flush first (its pieces are with
+                # the loop thread), then push the queued remainder
+                # ourselves — the tx condition serializes the two.
+                deadline = time.monotonic() + 2.0
+                while chan._tx_inflight and time.monotonic() < deadline:
+                    chan._tx_cond.wait(0.05)
+                if chan._txq:
+                    try:
+                        chan.sock.settimeout(2.0)
+                        for piece, _end in chan._txq:
+                            chan.sock.sendall(piece)
+                    except OSError:
+                        pass
+                    finally:
+                        chan._txq.clear()
+                        chan._tx_bytes = 0
+                        try:
+                            chan.sock.setblocking(False)
+                        except OSError:
+                            pass
+        if already:
+            return
+        if on_loop:
+            self._finalize(chan)
+        else:
+            self._submit("close", chan)
+
+    @contextmanager
+    def hold_tx(self):
+        """Test hook: park every egress flush while the context is held,
+        so a burst of sends lands in the write queues and the release
+        flush demonstrates (and lets tests assert) coalescing."""
+        self._hold_tx = True
+        try:
+            yield
+        finally:
+            self._hold_tx = False
+            self._submit("txall", None)
+
+    @property
+    def thread(self) -> threading.Thread:
+        return self._thread
+
+    def channel_count(self) -> int:
+        return len(self._selector.get_map()) - 1  # minus the wake pipe
+
+    # -- loop body --------------------------------------------------------
+    def _run(self) -> None:
+        while not self._closed:
+            try:
+                self._turn()
+            except Exception:  # pragma: no cover - belt and braces
+                logger.exception("transport event loop turn failed")
+                time.sleep(0.01)
+
+    def _turn(self) -> None:
+        timeout = None
+        if self._stalled:
+            now = time.monotonic()
+            timeout = max(0.0, min(
+                c._stall_until for c in self._stalled) - now)
+        with self._lock:
+            if self._pending:
+                timeout = 0  # ops queued while we were mid-turn
+            else:
+                self._in_select = True
+        events = self._selector.select(timeout)
+        _m_wakeups.inc()
+        wake_ready = any(key.data is None for key, _mask in events)
+        if wake_ready:
+            # Drain the wake pipe BEFORE clearing the armed flag: the
+            # flag promises "a wake byte is in flight for you" —
+            # draining after the clear could swallow a byte a mid-turn
+            # submitter wrote for its freshly-armed wake, leaving
+            # armed=True with an empty pipe, after which every later
+            # submit skips the write and the loop sleeps through pending
+            # ops forever (the lost-wakeup race this ordering kills). A
+            # byte written after this drain just makes the next select
+            # return immediately.
+            try:
+                while self._wake_r.recv(4096):
+                    pass
+            except (BlockingIOError, InterruptedError):
+                pass
+        with self._lock:
+            self._in_select = False
+            self._wake_armed = False
+            ops, self._pending = self._pending, []
+        for op, chan in ops:
+            if op == "add":
+                self._add(chan)
+            elif op == "tx":
+                if chan._registered and not chan._tx_closing:
+                    self._flush(chan)
+            elif op == "txall":
+                for key in list(self._selector.get_map().values()):
+                    c = key.data
+                    if c is not None and c._txq and not c._tx_closing:
+                        self._flush(c)
+            elif op == "close":
+                self._finalize(chan)
+        for key, mask in events:
+            chan = key.data
+            if chan is None:
+                continue  # wake pipe — drained above
+            if not chan._registered:
+                continue  # closed by an earlier op this turn
+            if mask & selectors.EVENT_READ:
+                self._readable(chan)
+            if (mask & selectors.EVENT_WRITE) and chan._registered:
+                self._flush(chan)
+        if self._stalled:
+            self._service_stalls()
+        if self._rx_batches:
+            # One inbox extend + notify per ENDPOINT per turn: a 64-way
+            # fan-in delivers the whole turn's decode in one condition
+            # round instead of 64.
+            batches, self._rx_batches = self._rx_batches, {}
+            for owner, items in batches.items():
+                if items:
+                    # Guarded: a turn that only advanced a mid-frame
+                    # decode leaves an empty batch, and an empty
+                    # put_many would still notify — spuriously waking
+                    # the consumer once per turn of a large transfer.
+                    owner._inbox.put_many(items)
+
+    # -- registration -----------------------------------------------------
+    def _add(self, chan) -> None:
+        try:
+            self._selector.register(
+                chan.sock, selectors.EVENT_READ, chan)
+        except (ValueError, KeyError, OSError):
+            # Socket died between accept and registration.
+            chan.owner._drop_channel(chan)
+            return
+        chan._registered = True
+        chan._ev_mask = selectors.EVENT_READ
+        _m_channels.set(self.channel_count())
+        if chan._txq:
+            self._flush(chan)
+
+    def _set_mask(self, chan, mask: int) -> None:
+        if chan._ev_mask == mask or not chan._registered:
+            return
+        try:
+            self._selector.modify(chan.sock, mask, chan)
+            chan._ev_mask = mask
+        except (ValueError, KeyError, OSError):
+            self._drop(chan)
+
+    def _finalize(self, chan) -> None:
+        if chan._registered:
+            chan._registered = False
+            try:
+                self._selector.unregister(chan.sock)
+            except (ValueError, KeyError, OSError):
+                pass
+            _m_channels.set(self.channel_count())
+        self._stalled.discard(chan)
+        chan._tx_head.clear()
+        with chan._tx_cond:
+            chan._txq.clear()
+            chan._tx_bytes = 0
+            chan._tx_inflight = False
+            chan._tx_cond.notify_all()
+        try:
+            chan.sock.close()
+        except OSError:
+            pass
+
+    def _drop(self, chan) -> None:
+        """Connection-level failure: hand the channel back to its
+        endpoint (counter folding, sentinel wake) — which re-enters
+        close_channel → _finalize on this thread."""
+        chan.owner._drop_channel(chan)
+
+    # -- ingress ----------------------------------------------------------
+    #: Bytes one channel may drain per readiness event before yielding
+    #: the loop to its siblings — drain-until-EAGAIN (one select per
+    #: kernel-buffered burst instead of one per recv) bounded so a
+    #: firehose peer cannot starve the other channels for a whole
+    #: tensor.
+    RX_TURN_BUDGET = 4 << 20
+
+    def _readable(self, chan) -> None:
+        got = 0
+        eof = False
+        while got < self.RX_TURN_BUDGET:
+            try:
+                n = chan._fb.fill(chan.sock)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                self._drop(chan)
+                return
+            if n == 0:
+                eof = True
+                break
+            got += n
+            if n < 65536:
+                # Short read: the kernel buffer is (almost certainly)
+                # empty — skip the EAGAIN probe syscall. Safe because
+                # select is level-triggered: any bytes that did land
+                # re-notify on the next turn.
+                break
+        if got:
+            # Frames completed before an EOF still deliver — the peer
+            # flushed them before closing.
+            self._pump_rx(chan)
+        if eof:
+            self._drop(chan)
+
+    def _pump_rx(self, chan) -> None:
+        """Decode and deliver every complete frame buffered on ``chan``.
+        Delivery batches bound-ingress frames into the turn's
+        per-endpoint batch (one inbox extend + condition notify per
+        endpoint per TURN, flushed at the end of :meth:`_turn`), and the
+        process-wide registry twins of the wire counters are bumped once
+        per batch (the per-channel/endpoint counters stay exact
+        per-frame inside handle_frame)."""
+        batch = self._rx_batches.get(chan.owner)
+        if batch is None:
+            batch = self._rx_batches.setdefault(chan.owner, [])
+        rx_bytes = 0
+        rx_frames = 0
+        try:
+            while chan._stall_until is None:
+                try:
+                    frame = chan._fb.pop()
+                except OSError:
+                    self._drop(chan)
+                    return
+                if frame is None:
+                    break
+                rx_bytes += len(frame) + 8
+                rx_frames += 1
+                stall = chan.handle_frame(frame, True, batch, False)
+                if stall is not None:
+                    stall_s, drop = stall
+                    chan._stall_until = time.monotonic() + stall_s
+                    chan._stall_pending = (frame, drop)
+                    self._stalled.add(chan)
+                    break
+        finally:
+            if rx_frames:
+                _m_bytes_rx.inc(rx_bytes)
+                _m_frames_rx.inc(rx_frames)
+
+    def _service_stalls(self) -> None:
+        now = time.monotonic()
+        for chan in [c for c in self._stalled
+                     if c._stall_until is not None
+                     and c._stall_until <= now]:
+            self._stalled.discard(chan)
+            chan._stall_until = None
+            frame, drop = chan._stall_pending
+            chan._stall_pending = None
+            if not chan._registered:
+                continue
+            if drop:
+                # Loss model: hand the consumed window slot back (same
+                # compensation as the threads path).
+                try:
+                    chan.send_credit(1)
+                except OSError:
+                    pass
+            else:
+                chan.deliver_data(frame)
+            self._pump_rx(chan)
+
+    # -- egress -----------------------------------------------------------
+    def _flush(self, chan) -> None:
+        """Drain ``chan``'s write queue with coalesced vectored sends:
+        one ``sendmsg`` gathers queued pieces up to the configured
+        coalescing cap (whole frames of any size always ship — a large
+        payload is one iovec entry, never split or copied). The queued
+        pieces move to a loop-owned head under the tx condition, then
+        every syscall runs OUTSIDE it — a producer keeps enqueueing
+        while the kernel copies."""
+        if self._hold_tx:
+            return
+        from fiber_tpu import config
+
+        cap = int(getattr(config.get(), "transport_coalesce_max",
+                          256 * 1024)) or (256 * 1024)
+        head = chan._tx_head
+        with chan._tx_cond:
+            chan._tx_dirty = False
+            if chan._tx_closing:
+                chan._tx_inflight = False
+                chan._tx_cond.notify_all()
+                return
+            if chan._txq:
+                head.extend(chan._txq)
+                chan._txq.clear()
+            chan._tx_inflight = bool(head)
+        error = False
+        sent_total = 0
+        while head:
+            iov = []
+            take = 0
+            for piece, _end in head:
+                iov.append(piece)
+                take += len(piece)
+                if take >= cap or len(iov) >= _IOV_MAX:
+                    break
+            try:
+                sent = chan.sock.sendmsg(iov)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                error = True
+                break
+            if sent <= 0:
+                break
+            sent_total += sent
+            chan.flushes_tx += 1
+            frames_done = 0
+            while sent and head:
+                piece, end = head[0]
+                n = len(piece)
+                if sent >= n:
+                    sent -= n
+                    head.popleft()
+                    if end:
+                        frames_done += 1
+                else:
+                    head[0] = (memoryview(piece)[sent:], end)
+                    sent = 0
+            _m_flush_frames.observe(frames_done)
+        if sent_total:
+            _m_flush_bytes.observe(sent_total)
+        with chan._tx_cond:
+            chan._tx_bytes -= sent_total
+            chan._tx_inflight = bool(head)
+            pending = bool(head) or bool(chan._txq)
+            chan._tx_cond.notify_all()
+        if error:
+            self._drop(chan)
+            return
+        self._set_mask(
+            chan,
+            selectors.EVENT_READ | selectors.EVENT_WRITE
+            if pending else selectors.EVENT_READ,
+        )
+
+    # -- lifecycle --------------------------------------------------------
+    def close(self) -> None:  # pragma: no cover - process teardown only
+        self._closed = True
+        self.wake()
+
+
+_loop: Optional[EventLoop] = None
+_loop_pid: Optional[int] = None
+_loop_guard = threading.Lock()
+
+
+def get_loop() -> EventLoop:
+    """The process-wide poller, created on first use. Guarded by pid so a
+    forked child never inherits a loop whose thread died in the fork."""
+    global _loop, _loop_pid
+    pid = os.getpid()
+    with _loop_guard:
+        if _loop is None or _loop_pid != pid:
+            _loop = EventLoop()
+            _loop_pid = pid
+        return _loop

@@ -26,11 +26,14 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
-from fiber_tpu import auth, telemetry
+from fiber_tpu import auth, config, telemetry
 from fiber_tpu.testing import chaos
 from fiber_tpu.framing import (
+    SMALL_FRAME_MAX,
     ConnectionClosed,
-    recv_frame,
+    FrameBuffer,
+    FrameReader,
+    pack_header,
     send_frame,
 )
 from fiber_tpu.utils.logging import get_logger
@@ -63,6 +66,7 @@ _WAKE = object()  # recv_req nudge (Endpoint.wake), never delivered as data
 # uses credits; rw/req/rep frames are always DATA.
 _T_DATA = b"\x00"
 _T_CREDIT = b"\x01"
+_T_CREDIT_BYTE = _T_CREDIT[0]  # int compare — no per-frame slice alloc
 _CREDIT = struct.Struct(">I")
 
 #: Standing credit window granted per peer by bound r-endpoints (fan-in
@@ -88,10 +92,20 @@ class _Inbox:
             self._items.append(item)
             self._cond.notify_all()
 
+    def put_many(self, items) -> None:
+        """Append a batch under one lock round and one notify — the
+        selector loop delivers every frame decoded from one readiness
+        event this way instead of paying a condition dance per frame."""
+        with self._cond:
+            self._items.extend(items)
+            self._cond.notify_all()
+
     def get(self, timeout: Optional[float] = None):
         with self._cond:
-            if not self._cond.wait_for(lambda: len(self._items) > 0, timeout):
-                return _SENTINEL_EMPTY
+            if not self._items:  # fast path: skip the predicate closure
+                if not self._cond.wait_for(
+                        lambda: len(self._items) > 0, timeout):
+                    return _SENTINEL_EMPTY
             return self._items.popleft()
 
     def peek(self, timeout: Optional[float] = None):
@@ -119,7 +133,14 @@ _SENTINEL_EMPTY = object()
 
 
 class _Channel:
-    """One TCP connection plus its reader thread."""
+    """One TCP connection. Its I/O engine is the owning endpoint's
+    ``transport_io`` mode: ``"threads"`` runs the classic blocking
+    reader thread per connection; ``"selector"`` hands the socket to
+    the process-wide poller (fiber_tpu/transport/evloop.py) — no
+    per-connection thread, writes through a coalescing queue. Per-frame
+    semantics (credits, chaos ingress hook, inbox delivery, counters)
+    live in :meth:`handle_frame`, shared by both engines so they cannot
+    diverge."""
 
     _ids = itertools.count()
 
@@ -132,17 +153,43 @@ class _Channel:
         self.replenish_owed = 0  # batched standing-window replenish
         self.last_rx: Optional[float] = None  # monotonic, any frame kind
         # Exact wire-volume counters at the framing boundary (monotonic;
-        # single-writer each: rx by this channel's reader thread, tx
-        # under _send_lock — so reads need no extra locking).
+        # single-writer each: rx by the I/O engine, tx under _send_lock /
+        # _tx_cond — so reads need no extra locking). flushes_tx counts
+        # egress syscalls: == frames_tx on the threads path, <= frames_tx
+        # under the selector loop's small-frame coalescing.
         self.bytes_rx = 0
         self.bytes_tx = 0
         self.frames_rx = 0
         self.frames_tx = 0
+        self.flushes_tx = 0
         self._send_lock = threading.Lock()
         sock.setsockopt(pysocket.IPPROTO_TCP, pysocket.TCP_NODELAY, 1)
         self._reader: Optional[threading.Thread] = None
+        self._io_selector = owner._io == "selector"
+        self._loop = None
+        if self._io_selector:
+            from fiber_tpu.transport.evloop import get_loop
 
-    def start_reader(self) -> None:
+            self._loop = get_loop()
+            self._fb = FrameBuffer()
+            self._txq: "collections.deque" = collections.deque()
+            self._tx_head: "collections.deque" = collections.deque()
+            self._tx_bytes = 0
+            self._tx_cond = threading.Condition()
+            self._tx_dirty = False
+            self._tx_closing = False
+            self._tx_inflight = False
+            self._registered = False
+            self._ev_mask = 0
+            self._stall_until: Optional[float] = None
+            self._stall_pending = None
+
+    def start_io(self) -> None:
+        """Attach the connection to its I/O engine (reader thread or
+        the selector loop)."""
+        if self._io_selector:
+            self._loop.register_channel(self)
+            return
         self._reader = threading.Thread(
             target=self._read_loop,
             name=f"fiber-chan-{self.cid}",
@@ -150,82 +197,211 @@ class _Channel:
         )
         self._reader.start()
 
+    # -- shared ingress ---------------------------------------------------
+    def handle_frame(self, frame, defer_stall: bool = False,
+                     batch=None, registry: bool = True):
+        """One received frame, decoded: counters, credit accounting, the
+        chaos ingress hook, and inbox delivery — identical under both
+        I/O engines. Returns None normally. When ``defer_stall`` and a
+        chaos plan injects an ingress stall, returns ``(stall_s, drop)``
+        WITHOUT sleeping so the selector loop can park just this channel
+        (sleeping the poller would stall every channel in the process);
+        the caller delivers/drops the frame at the deadline."""
+        # Observable silence: the failure detector reads last_rx instead
+        # of opening extra sockets; credit frames count too (any byte
+        # proves the peer's stack is alive).
+        self.last_rx = self.owner.last_rx = time.monotonic()
+        wire = len(frame) + 8  # + length header
+        self.bytes_rx += wire
+        self.frames_rx += 1
+        if registry:  # False: the selector loop bumps the registry
+            _m_bytes_rx.inc(wire)  # twins once per decode batch
+            _m_frames_rx.inc()
+        if frame and frame[0] == _T_CREDIT_BYTE:
+            (n,) = _CREDIT.unpack(bytes(frame[1:5]))
+            with self.owner._chan_lock:
+                self.credit += n
+                self.owner._chan_lock.notify_all()
+            return None
+        # Chaos injection point (no-op unless a plan is active): bound-r
+        # ingress only — REQ/REP and connected endpoints have lockstep
+        # protocols a dropped/stalled frame would wedge rather than
+        # degrade, which is not the fault being modeled.
+        plan = chaos._plan
+        if (plan is not None and self.owner._is_bound
+                and self.owner.mode == "r"):
+            stall_s, drop = plan.recv_frame_actions(self)
+            if stall_s > 0.0:
+                if defer_stall:
+                    return (stall_s, drop)
+                time.sleep(stall_s)
+            if drop:
+                # Dropped: model LOSS, not throttling — hand the
+                # consumed window slot back so the sender's standing
+                # credit doesn't shrink per drop.
+                try:
+                    self.send_credit(1)
+                except OSError:
+                    pass
+                return None
+        self.deliver_data(frame, batch)
+        return None
+
+    def deliver_data(self, frame, batch=None) -> None:
+        """Strip the 1-byte type tag and hand the payload to the owner's
+        inbox. Large frames are stripped with a memoryview (the old
+        ``frame[1:]`` slice re-copied every host-plane tensor); small
+        ones stay plain bytearray slices."""
+        if len(frame) > SMALL_FRAME_MAX:
+            payload = memoryview(frame)[1:]
+        else:
+            payload = frame[1:]
+        owner = self.owner
+        # Arrival consumes the credit that pulled it: count each
+        # undelivered frame ONCE (inbox qsize), so the prefetch window
+        # arithmetic in _maybe_grant doesn't double-count frames as both
+        # queued and outstanding. Enqueue and decrement under the lock
+        # _maybe_grant holds: decrementing before enqueueing (the old
+        # order) let a concurrent grant see neither the queued frame nor
+        # the outstanding credit and over-grant past the parked-frame
+        # bound (advisor, round 2). _Inbox locks are leaf-level and
+        # readers never block holding _recv_lock, so this nesting cannot
+        # deadlock.
+        if owner._demand_driven:
+            with owner._recv_lock:
+                owner._inbox.put((self, payload))
+                if owner._credit_outstanding > 0:
+                    owner._credit_outstanding -= 1
+        elif batch is not None:
+            batch.append((self, payload))
+        else:
+            owner._inbox.put((self, payload))
+
     def _read_loop(self) -> None:
+        reader = FrameReader(self.sock)
         try:
             while True:
-                frame = recv_frame(self.sock)
-                # Observable silence: the failure detector reads last_rx
-                # instead of opening extra sockets; credit frames count
-                # too (any byte proves the peer's stack is alive).
-                self.last_rx = self.owner.last_rx = time.monotonic()
-                self.bytes_rx += len(frame) + 8  # + length header
-                self.frames_rx += 1
-                _m_bytes_rx.inc(len(frame) + 8)
-                _m_frames_rx.inc()
-                kind = frame[:1]
-                if kind == _T_CREDIT:
-                    (n,) = _CREDIT.unpack(frame[1:5])
-                    with self.owner._chan_lock:
-                        self.credit += n
-                        self.owner._chan_lock.notify_all()
-                else:
-                    # Chaos injection point (no-op unless a plan is
-                    # active): bound-r ingress only — REQ/REP and
-                    # connected endpoints have lockstep protocols a
-                    # dropped/stalled frame would wedge rather than
-                    # degrade, which is not the fault being modeled.
-                    plan = chaos._plan
-                    if (plan is not None and self.owner._is_bound
-                            and self.owner.mode == "r"
-                            and not plan.on_recv_frame(self)):
-                        # Dropped: model LOSS, not throttling — hand the
-                        # consumed window slot back so the sender's
-                        # standing credit doesn't shrink per drop.
-                        try:
-                            self.send_credit(1)
-                        except OSError:
-                            pass
-                        continue
-                    # Arrival consumes the credit that pulled it: count
-                    # each undelivered frame ONCE (inbox qsize), so the
-                    # prefetch window arithmetic in _maybe_grant doesn't
-                    # double-count frames as both queued and outstanding.
-                    # Enqueue and decrement under the lock _maybe_grant
-                    # holds: decrementing before enqueueing (the old
-                    # order) let a concurrent grant see neither the
-                    # queued frame nor the outstanding credit and
-                    # over-grant past the parked-frame bound (advisor,
-                    # round 2). _Inbox locks are leaf-level and readers
-                    # never block holding _recv_lock, so this nesting
-                    # cannot deadlock.
-                    if self.owner._demand_driven:
-                        with self.owner._recv_lock:
-                            self.owner._inbox.put((self, frame[1:]))
-                            if self.owner._credit_outstanding > 0:
-                                self.owner._credit_outstanding -= 1
-                    else:
-                        self.owner._inbox.put((self, frame[1:]))
+                self.handle_frame(reader.recv())
         except (ConnectionClosed, OSError):
             pass
         finally:
             self.owner._drop_channel(self)
 
-    def send(self, payload: bytes) -> None:
-        with self._send_lock:
-            send_frame(self.sock, payload, prefix=_T_DATA)
-            self.bytes_tx += len(payload) + _FRAME_OVERHEAD
+    # -- egress -----------------------------------------------------------
+    def _tx_enqueue(self, pieces, wire_bytes: int) -> None:
+        """Queue frame pieces for the selector loop's coalescing flush.
+        ``pieces`` is a list of ``(buffer, frame_end)`` tuples; the
+        counters commit here — the frame is on its way to the wire (the
+        same guarantee a blocking sendall's return gave: kernel-buffered,
+        not yet acknowledged). Blocks past the queue's high-water mark
+        (bounded memory), except on the loop thread itself, which must
+        never wait on its own drain.
+
+        Large frames take an inline fast path when nothing is queued or
+        in flight: the caller's own thread pushes the iovec until the
+        kernel buffer pushes back (EAGAIN), so a worker streaming
+        tensors overlaps its copy-to-kernel with the loop's ingress work
+        exactly like a dedicated sender thread would — only the EAGAIN
+        remainder is left for the poller."""
+        from fiber_tpu.transport.evloop import TX_HIGH_WATER
+
+        loop = self._loop
+        with self._tx_cond:
+            if not self.alive or self._tx_closing:
+                raise TransportClosed("channel closed")
+            if (self._tx_bytes > TX_HIGH_WATER
+                    and threading.current_thread() is not loop.thread):
+                while (self._tx_bytes > TX_HIGH_WATER and self.alive
+                       and not self._tx_closing):
+                    self._tx_cond.wait(0.5)
+                if not self.alive or self._tx_closing:
+                    raise TransportClosed("channel closed")
+            if (wire_bytes > SMALL_FRAME_MAX and self._registered
+                    and not self._txq and not self._tx_inflight):
+                pieces = self._inline_send(pieces)
+                if pieces is None:  # fully on the wire
+                    self.bytes_tx += wire_bytes
+                    self.frames_tx += 1
+                    return
+            self._txq.extend(pieces)
+            self._tx_bytes += wire_bytes
+            self.bytes_tx += wire_bytes
             self.frames_tx += 1
-        _m_bytes_tx.inc(len(payload) + _FRAME_OVERHEAD)
+            dirty = self._tx_dirty
+            self._tx_dirty = True
+        if not dirty:
+            loop.request_flush(self)
+
+    def _inline_send(self, pieces):
+        """Under the tx condition (order is safe: queue empty, loop not
+        flushing): vectored non-blocking sends until done or EAGAIN.
+        Returns None when everything shipped, else the remaining pieces
+        (partial head trimmed to a memoryview). OSError propagates like
+        a failed blocking send."""
+        iov = [p for p, _end in pieces]
+        while iov:
+            try:
+                sent = self.sock.sendmsg(iov)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                raise
+            if sent <= 0:
+                break
+            self.flushes_tx += 1
+            while sent and iov:
+                n = len(iov[0])
+                if sent >= n:
+                    sent -= n
+                    iov.pop(0)
+                    pieces.pop(0)
+                else:
+                    iov[0] = memoryview(iov[0])[sent:]
+                    pieces[0] = (iov[0], pieces[0][1])
+                    sent = 0
+        return pieces if iov else None
+
+    def send(self, payload: bytes) -> None:
+        wire = len(payload) + _FRAME_OVERHEAD
+        if self._io_selector:
+            header = pack_header(len(payload) + 1)
+            if len(payload) > SMALL_FRAME_MAX:
+                # Scatter-gather shape: tiny header+tag piece, then the
+                # payload as one uncopied iovec entry.
+                pieces = [(header + _T_DATA, False),
+                          (memoryview(payload), True)]
+            else:
+                if not isinstance(payload, (bytes, bytearray)):
+                    payload = bytes(payload)
+                pieces = [(header + _T_DATA + payload, True)]
+            self._tx_enqueue(pieces, wire)
+        else:
+            with self._send_lock:
+                send_frame(self.sock, payload, prefix=_T_DATA)
+                self.bytes_tx += wire
+                self.frames_tx += 1
+                self.flushes_tx += 1
+        _m_bytes_tx.inc(wire)
         _m_frames_tx.inc()
 
     def send_credit(self, n: int) -> None:
+        wire = _CREDIT.size + _FRAME_OVERHEAD
+        if self._io_selector:
+            body = _T_CREDIT + _CREDIT.pack(n)
+            self._tx_enqueue(
+                [(pack_header(len(body)) + body, True)], wire)
+            return
         with self._send_lock:
             send_frame(self.sock, _T_CREDIT + _CREDIT.pack(n))
-            self.bytes_tx += _CREDIT.size + _FRAME_OVERHEAD
+            self.bytes_tx += wire
             self.frames_tx += 1
+            self.flushes_tx += 1
 
     def close(self) -> None:
         self.alive = False
+        if self._io_selector and self._loop is not None:
+            self._loop.close_channel(self)
+            return
         try:
             self.sock.close()
         except OSError:
@@ -233,10 +409,21 @@ class _Channel:
 
 
 class Endpoint:
-    def __init__(self, mode: str, prefetch: int = 1) -> None:
+    def __init__(self, mode: str, prefetch: int = 1,
+                 io: Optional[str] = None) -> None:
         if mode not in MODES:
             raise ValueError(f"invalid endpoint mode {mode!r}")
         self.mode = mode
+        # I/O engine for this endpoint's channels: "selector" (one
+        # process-wide poller, O(1) threads in peer count, coalesced
+        # vectored sends) or "threads" (blocking reader thread per
+        # connection). Resolved once at construction from the
+        # transport_io config knob; ``io=`` overrides for tests/benches
+        # that compare the engines side by side. docs/transport.md.
+        self._io = io or str(getattr(config.get(), "transport_io",
+                                     "selector"))
+        if self._io not in ("selector", "threads"):
+            raise ValueError(f"invalid transport_io {self._io!r}")
         # r-mode credit window: 1 = pure demand-driven (a dead consumer
         # never has frames parked beyond what a blocked reader asked
         # for); >1 pipelines a bounded window for throughput.
@@ -274,7 +461,7 @@ class Endpoint:
         # Wire totals of channels that have already been dropped, so the
         # endpoint aggregates (bytes_tx etc.) stay monotonic across
         # reconnects.
-        self._dead_wire = [0, 0, 0, 0]  # bytes_rx, bytes_tx, f_rx, f_tx
+        self._dead_wire = [0, 0, 0, 0, 0]  # b_rx, b_tx, f_rx, f_tx, fl_tx
 
     # -- wiring -----------------------------------------------------------
     def bind(self, ip: str, port: int = 0) -> str:
@@ -397,14 +584,16 @@ class Endpoint:
         with self._chan_lock:
             self._channels.append(chan)
             self._chan_lock.notify_all()
-        # Every channel gets a reader: data/credit frames for receiving
-        # modes, EOF detection for send-only ones.
-        chan.start_reader()
+        # Every channel gets an I/O engine: data/credit frames for
+        # receiving modes, EOF detection for send-only ones.
+        chan.start_io()
         if self.mode == "r" and self._is_bound:
             # Fan-in ingress (e.g. pool result streams): standing credit
             # window per peer, replenished as frames are consumed.
             try:
-                chan.send_credit(DEFAULT_CREDIT_WINDOW)
+                chan.send_credit(int(getattr(
+                    config.get(), "transport_credit_window",
+                    DEFAULT_CREDIT_WINDOW)) or DEFAULT_CREDIT_WINDOW)
             except OSError:
                 pass
 
@@ -418,6 +607,7 @@ class Endpoint:
                 dead[1] += chan.bytes_tx
                 dead[2] += chan.frames_rx
                 dead[3] += chan.frames_tx
+                dead[4] += chan.flushes_tx
             now_empty = not self._channels
         chan.close()
         # A connected endpoint has no listener: losing its only channel is
@@ -649,6 +839,15 @@ class Endpoint:
     @property
     def frames_tx(self) -> int:
         return self._wire_total(3, "frames_tx")
+
+    @property
+    def flushes_tx(self) -> int:
+        """Egress syscalls across every channel this endpoint ever had:
+        equals ``frames_tx`` on the threads path; under the selector
+        loop's coalescing, N small frames queued between wakeups leave
+        in one flush, so this counts how often that actually paid off
+        (tested: tests/test_transport.py coalescing suite)."""
+        return self._wire_total(4, "flushes_tx")
 
     # -- lifecycle --------------------------------------------------------
     def peer_count(self) -> int:

@@ -78,8 +78,11 @@ def test_install_exports_plan_to_children(chaos_plan):
 def test_worker_killed_mid_map_completes(chaos_plan):
     """(a) A worker hard-killed mid-map (after its N-th chunk) strands
     nothing: the pending table resubmits and the map returns complete,
-    correct, in-order results."""
+    correct, in-order results. Pinned to transport_io=selector (the
+    default) so the pool-kill recovery path is exercised through the
+    event-loop data plane even if the default ever flips."""
     plan = chaos_plan(kill_after_chunks=2, kill_times=1)
+    fiber_tpu.init(transport_io="selector")
     with fiber_tpu.Pool(2) as pool:
         xs = list(range(120))
         assert pool.map(targets.square, xs, chunksize=4) == \
@@ -135,17 +138,25 @@ def test_ingress_stall_longer_than_suspect_timeout_resubmits(chaos_plan):
         assert pool._detector.suspected_total >= 1
 
 
-def test_transport_drop_frames_endpoint_level(chaos_plan):
+@pytest.mark.parametrize("io", ["threads", "selector"])
+def test_transport_drop_frames_endpoint_level(chaos_plan, io):
     """Bound-r ingress frame DROP at the Endpoint boundary: lost frames
     stay lost (loss model), the rest keep flowing, and the sender's
-    credit window is compensated so throughput doesn't decay."""
+    credit window is compensated so throughput doesn't decay.
+
+    Parametrized over both I/O engines (docs/transport.md): the chaos
+    plan consults one counter per channel (`recv_frame_actions`), so the
+    drop schedule AND the credit compensation must be observably
+    identical under the selector event loop and the thread-per-
+    connection fallback — asserted below down to the exact credit-frame
+    count."""
     from fiber_tpu import serialization
     from fiber_tpu.transport.tcp import Endpoint
 
     chaos_plan(drop_recv_every=3)
-    server = Endpoint("r")
+    server = Endpoint("r", io=io)
     addr = server.bind("127.0.0.1")
-    client = Endpoint("w").connect(addr)
+    client = Endpoint("w", io=io).connect(addr)
     try:
         n = 30
         for i in range(n):
@@ -158,6 +169,11 @@ def test_transport_drop_frames_endpoint_level(chaos_plan):
                 break
         # every 3rd frame dropped, order preserved for the survivors
         assert got == [i for i in range(n) if (i + 1) % 3 != 0]
+        # Credit handed back for every dropped frame: the server sent
+        # exactly 1 window grant + n/3 compensation credits (the 20
+        # delivered recvs stay below the 32-frame replenish batch), the
+        # same under both engines.
+        assert server.frames_tx == 1 + n // 3
     finally:
         client.close()
         server.close()

@@ -4,7 +4,7 @@
 PYTEST ?= python -m pytest tests/ -q
 
 .PHONY: test stest test-all lint bench bench-store bench-telemetry \
-	bench-sched bench-transport weakscale docs chaos
+	bench-sched bench-transport bench-cluster weakscale docs chaos
 
 # Tier 1: local backend (subprocess jobs)
 test:
@@ -72,6 +72,18 @@ bench-sched:
 bench-transport:
 	JAX_PLATFORMS=cpu python bench.py --transport > BENCH_transport.json; \
 	rc=$$?; cat BENCH_transport.json; exit $$rc
+
+# Full-stack macro bench (docs/observability.md, ROADMAP item 5): the
+# whole stack at once — simulated multi-host pod, 8MB per-generation
+# store broadcasts, straggler + worker-kill chaos, full tracing +
+# flight recorder. FAILS on an evals/s or bytes-per-task regression,
+# on an explain misattribution of the injected straggler, or on a
+# missing postmortem bundle after the chaos kill; archives a Perfetto
+# trace + flight-event artifact per run into RUNS/. The record lands
+# in BENCH_cluster.json either way.
+bench-cluster:
+	JAX_PLATFORMS=cpu python bench.py --cluster > BENCH_cluster.json; \
+	rc=$$?; cat BENCH_cluster.json; exit $$rc
 
 # Weak-scaling record over 1/2/4/8-device sim meshes (fused ES,
 # population scaled with devices) + strong curve (constant total pop)

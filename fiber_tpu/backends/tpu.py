@@ -548,6 +548,16 @@ class TpuBackend(Backend):
         return out
 
     # -- telemetry (docs/observability.md) -----------------------------
+    def collect_postmortem(self, host_key: str) -> Optional[dict]:
+        """One host's black box (the agent's ``postmortem`` op): flight
+        events, stack dump, and any crash bundles workers there flushed.
+        ``host_key`` is the scheduler-plane ``ip:port`` key workers
+        self-report; None when it doesn't name a known agent."""
+        host, _, port_s = host_key.rpartition(":")
+        if not host or not port_s.isdigit():
+            return None
+        return self._agent((host, int(port_s))).call("postmortem")
+
     def cluster_metrics(self) -> Dict[str, dict]:
         """Per-host telemetry snapshots keyed like :meth:`host_health` /
         :meth:`store_stats` (one operator surface), via each agent's

@@ -18,6 +18,11 @@ up             print (or execute) the commands that start agents on every
 status         ping every host agent and report liveness/host info
 metrics        fetch every agent's telemetry snapshot (counters/timers;
                --prom renders Prometheus v0.0.4 text exposition)
+explain        classify where a traced map's time went (straggler /
+               locality-miss / backpressure / transport-stall /
+               store-fetch) from a trace artifact + flight events
+postmortem     list/print black-box bundles (dead-worker flight events
+               + stack dumps), locally or pulled from host agents
 logs           fetch a job's log tail by jid (host:port/jobid)
 cp             stage files to/from hosts through the agents
 =============  ==========================================================
@@ -668,6 +673,102 @@ def cmd_metrics(args) -> int:
     return rc
 
 
+def cmd_explain(args) -> int:
+    """Join a trace artifact (``Pool.trace_dump`` Chrome JSON or a raw
+    span list) with flight events (``Pool.flight_dump``) and print the
+    ranked blame budget (docs/observability.md)."""
+    from fiber_tpu.telemetry import explain as explainmod
+
+    try:
+        spans = explainmod.load_spans(args.trace)
+    except (OSError, ValueError) as err:
+        raise SystemExit(f"error: cannot load trace: {err}") from None
+    events = []
+    if args.flight:
+        try:
+            events = explainmod.load_events(args.flight)
+        except (OSError, ValueError) as err:
+            raise SystemExit(
+                f"error: cannot load flight events: {err}") from None
+    try:
+        verdict = explainmod.explain_trace(
+            spans, events, trace_id=args.trace_id or None,
+            quantile=args.quantile)
+    except ValueError as err:
+        raise SystemExit(f"error: {err}") from None
+    if args.json:
+        print(json.dumps(verdict))
+    else:
+        print(explainmod.render(verdict))
+    return 0
+
+
+def cmd_postmortem(args) -> int:
+    """Black-box bundles: with ``--hosts``/``--tpu``, pull each agent's
+    ``postmortem`` op (its flight buffer, stack dump, and the crash
+    bundles workers there flushed); without, list the bundles under the
+    local staging root (or ``--dir``)."""
+    from fiber_tpu.telemetry import postmortem
+
+    def describe(bundle: dict) -> str:
+        flight = bundle.get("flight") or []
+        return (f"reason={bundle.get('reason')} "
+                f"host={bundle.get('host')} pid={bundle.get('pid')} "
+                f"ident={bundle.get('ident', '-')} "
+                f"flight_events={len(flight)} "
+                f"stacks={'yes' if bundle.get('stacks') else 'no'}")
+
+    if args.hosts or getattr(args, "tpu", ""):
+        from fiber_tpu.backends.tpu import AgentClient
+
+        rc = 0
+        pulls = {}
+        for host, port in _resolve_cli_hosts(args):
+            key = f"{host}:{port}"
+            client = AgentClient(host, port)
+            try:
+                pulls[key] = client.call("postmortem")
+            except Exception as err:  # noqa: BLE001
+                print(f"{key}  DOWN  ({err})", file=sys.stderr)
+                rc = 1
+            finally:
+                client.close()
+        if args.json:
+            print(json.dumps(pulls, default=str))
+            return rc
+        for key, pull in pulls.items():
+            bundles = pull.get("bundles") or []
+            print(f"{key}  agent pid={pull.get('pid')} "
+                  f"flight_events={len(pull.get('flight') or [])} "
+                  f"bundles={len(bundles)}")
+            for bundle in bundles[-args.last:]:
+                print(f"  {describe(bundle)}")
+        return rc
+
+    directory = args.dir or postmortem.bundle_dir()
+    paths = postmortem.list_bundles(directory)
+    if args.json:
+        out = []
+        for path in paths[-args.last:]:
+            try:
+                out.append(postmortem.read_bundle(path))
+            except (OSError, ValueError):
+                continue
+        print(json.dumps(out, default=str))
+        return 0
+    if not paths:
+        print(f"no postmortem bundles under {directory}")
+        return 0
+    for path in paths[-args.last:]:
+        try:
+            bundle = postmortem.read_bundle(path)
+        except (OSError, ValueError) as err:
+            print(f"{path}  unreadable ({err})", file=sys.stderr)
+            continue
+        print(f"{path}\n  {describe(bundle)}")
+    return 0
+
+
 def cmd_logs(args) -> int:
     """Fetch a job's log tail by its jid (``host:port/jid`` — as printed
     by ``run --submit`` and carried by ``Process.job.jid``)."""
@@ -814,6 +915,48 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", action="store_true",
                    help="print the raw per-host snapshots as JSON")
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("explain",
+                       help="classify where a traced map's time went "
+                            "(straggler / locality-miss / backpressure "
+                            "/ transport-stall / store-fetch)")
+    p.add_argument("trace",
+                   help="trace artifact: Pool.trace_dump Chrome JSON "
+                        "or a raw span-list JSON")
+    p.add_argument("--flight", default="",
+                   help="flight-event artifact (Pool.flight_dump JSON) "
+                        "to join with the spans")
+    p.add_argument("--trace-id", default="",
+                   help="trace to explain (default: the one with the "
+                        "most spans in the artifact)")
+    p.add_argument("--quantile", type=float, default=2.0,
+                   help="straggler threshold: chunks slower than this "
+                        "multiple of the map median are blamed")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw verdict as JSON")
+    p.set_defaults(fn=cmd_explain)
+
+    p = sub.add_parser("postmortem",
+                       help="list/print black-box bundles (dead-worker "
+                            "flight events + stack dumps)")
+    p.add_argument("--hosts", default="",
+                   help="pull each agent's postmortem op instead of "
+                        "reading the local staging root")
+    p.add_argument("--tpu", default="",
+                   help="TPU name: derive worker addresses via gcloud "
+                        "describe when --hosts is absent")
+    p.add_argument("--zone", default="")
+    p.add_argument("--port", type=int, default=0,
+                   help="port for portless --hosts entries / derived "
+                        "addresses")
+    p.add_argument("--dir", default="",
+                   help="local bundle directory (default: "
+                        "<staging root>/postmortem)")
+    p.add_argument("--last", type=int, default=8,
+                   help="newest bundles to show per source")
+    p.add_argument("--json", action="store_true",
+                   help="print full bundles as JSON")
+    p.set_defaults(fn=cmd_postmortem)
 
     p = sub.add_parser("doctor",
                        help="diagnose the environment and cluster")

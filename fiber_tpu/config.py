@@ -130,6 +130,16 @@ DEFAULTS: Dict[str, Any] = {
     # Port for the authenticated Prometheus exposition endpoint
     # (telemetry.serve_metrics / the host agent's sidecar). 0 = off.
     "metrics_port": 0,
+    # Flight recorder (docs/observability.md): per-process ring buffer
+    # of structured plane events (pool/sched/store/transport/health) —
+    # the black box `fiber-tpu explain`, postmortem bundles and the
+    # cluster bench read. Near-zero when off; fully on it is gated
+    # <= 5% by `make bench-telemetry`'s flightrec arm. Requires
+    # telemetry_enabled too (one master switch for the whole plane).
+    "flightrec_enabled": True,
+    # Events kept in the ring before the oldest fall out (each is a
+    # small dict; 2048 bounds a long-lived master to ~1 MB).
+    "flightrec_buffer_size": 2048,
     # --- TPU backend ---
     "tpu_name": "",
     "tpu_zone": "",

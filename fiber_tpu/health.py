@@ -35,9 +35,15 @@ import time
 from typing import Callable, Dict, Iterable, Optional
 
 from fiber_tpu import telemetry
+from fiber_tpu.telemetry.flightrec import FLIGHT
 from fiber_tpu.utils.logging import get_logger
 
 logger = get_logger()
+
+
+def _peer_label(peer) -> str:
+    """Flight-event-safe peer name (pool idents are raw bytes)."""
+    return peer.hex() if isinstance(peer, (bytes, bytearray)) else str(peer)
 
 # Health-plane observability (docs/observability.md): breaker/suspect
 # state changes are exported metrics, not just log lines.
@@ -154,6 +160,7 @@ class FailureDetector:
             self._last_seen[peer] = now
         if revived:
             _m_revived.inc()
+            FLIGHT.record("health", "revive", peer=_peer_label(peer))
             logger.info("health: peer %r revived after being declared "
                         "dead", peer)
 
@@ -186,6 +193,9 @@ class FailureDetector:
                     self._dead.add(peer)
                     self.suspected_total += 1
                     _m_suspects.inc()
+                    FLIGHT.record(
+                        "health", "suspect", peer=_peer_label(peer),
+                        reason=f"silent > {self._timeout:g}s")
             for peer in expired:
                 try:
                     self._on_suspect(peer)
@@ -253,6 +263,9 @@ class CircuitBreaker:
             backoff = min(self._base * (2 ** (entry[1] - 1)), self._max)
             backoff *= 1.0 + self._jitter * self._rng.random()
             entry[2] = time.monotonic() + backoff
+            FLIGHT.record("health", "breaker_open",
+                          key=_peer_label(key), backoff_s=round(backoff, 4),
+                          opens=entry[1])
             entry[0] = 0  # streak restarts toward the next open
             now = time.monotonic()
             _g_breaker_open.set(sum(
@@ -262,7 +275,12 @@ class CircuitBreaker:
 
     def record_success(self, key) -> None:
         with self._lock:
-            self._state.pop(key, None)
+            entry = self._state.pop(key, None)
+            if entry is not None and entry[2] is not None:
+                # Only open->closed transitions are flight-worthy; the
+                # routine success of a never-failed key is not.
+                FLIGHT.record("health", "breaker_close",
+                              key=_peer_label(key))
             now = time.monotonic()
             _g_breaker_open.set(sum(
                 1 for e in self._state.values()

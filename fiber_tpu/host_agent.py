@@ -455,6 +455,31 @@ class HostAgent:
         return {"objects": count, "bytes": total}
 
     # -- telemetry (docs/observability.md) ------------------------------
+    def _op_postmortem(self, last: int = 256) -> dict:
+        """Black-box pull for this host: the agent process's own flight
+        events + all-thread stack dump, plus the newest crash bundles
+        workers on this host flushed under ``<staging>/postmortem/``
+        (the health plane calls this when it declares a worker here
+        dead; ``fiber-tpu postmortem --hosts`` is the operator form)."""
+        from fiber_tpu.telemetry import postmortem, tracing
+        from fiber_tpu.telemetry.flightrec import FLIGHT
+
+        bundles = []
+        pm_dir = postmortem.bundle_dir(self._staging_root)
+        for path in postmortem.list_bundles(pm_dir)[-8:]:
+            try:
+                bundles.append(postmortem.read_bundle(path))
+            except (OSError, ValueError):
+                continue
+        return {
+            "host": tracing.host_id(),
+            "pid": os.getpid(),
+            "flight": FLIGHT.snapshot(last=int(last)),
+            "stacks": postmortem.stack_dump(),
+            "bundle_dir": pm_dir,
+            "bundles": bundles,
+        }
+
     def _op_telemetry_snapshot(self) -> dict:
         """This agent process's metrics/timers/span-buffer state — the
         per-host payload ``TpuBackend.cluster_metrics`` and the

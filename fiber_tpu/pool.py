@@ -43,6 +43,7 @@ from fiber_tpu.sched import Scheduler, local_host_key
 from fiber_tpu.store.core import ObjectRef
 from fiber_tpu.store.plane import StoreFetchError
 from fiber_tpu.telemetry import tracing
+from fiber_tpu.telemetry.flightrec import FLIGHT
 from fiber_tpu.testing import chaos
 from fiber_tpu.transport import Endpoint, TransportClosed
 from fiber_tpu.utils.logging import get_logger
@@ -781,6 +782,16 @@ def _pool_worker_core(
     fiber_pid = fprocess.current_process().pid or os.getpid()
     funcs = _FuncCache()
 
+    if FLIGHT.enabled:
+        # Black-box posture (docs/observability.md): a dying worker
+        # flushes its flight buffer + stack dump into a postmortem
+        # bundle under the staging root — on SIGTERM/SIGABRT via the
+        # handler, and on the chaos harness's hard-kill via its
+        # pre-exit crash_flush hook.
+        from fiber_tpu.telemetry import postmortem
+
+        postmortem.install_crash_handler()
+
     from fiber_tpu.transport.tcp import connect_transport
 
     result_ep = connect_transport("w", result_addr)
@@ -912,6 +923,11 @@ def _pool_worker_core(
             # payloads of either shape decode.
             seq, base, digest, blob, chunk, star = msg[1:7]
             tctx = msg[7] if len(msg) > 7 else None
+            if FLIGHT.enabled:
+                # One event per chunk: the dead-worker bundle must show
+                # what the worker was chewing on when it died.
+                FLIGHT.record("pool", "chunk", seq=seq, base=base,
+                              items=len(chunk))
 
             def _wspan(name: str, **attrs):
                 # Spans only for traced chunks (the master sampled this
@@ -1358,6 +1374,11 @@ class Pool:
                 continue
             try:
                 if not fn(other_host):
+                    FLIGHT.record(
+                        "sched", "park", ident=ident.hex()[:8],
+                        host=host,
+                        reason="host suspect while healthier workers "
+                               "exist and work is scarce")
                     return True
             except Exception:  # noqa: BLE001
                 continue
@@ -1376,14 +1397,18 @@ class Pool:
             # Backpressure waits on the store's condition (woken by
             # every completion) instead of a 10ms poll; the timeout
             # only bounds how long a terminate() can go unnoticed.
-            waited = False
+            waited_t0 = None
             while not self._store.wait_outstanding_below(
                     MAX_INFLIGHT_TASKS, timeout=0.5):
-                waited = True
+                if waited_t0 is None:
+                    waited_t0 = time.perf_counter()
                 if self._terminated:
                     return
-            if waited:
+            if waited_t0 is not None:
                 _m_backpressure_waits.inc()
+                FLIGHT.record(
+                    "pool", "backpressure", seq=item[1][0],
+                    wait_s=round(time.perf_counter() - waited_t0, 4))
             while True:
                 if self._terminated:
                     return
@@ -1396,6 +1421,9 @@ class Pool:
                     global_timer.add("pool.dispatch",
                                      time.perf_counter() - t0)
                     _m_chunks_dispatched.inc()
+                    if FLIGHT.enabled:
+                        FLIGHT.record("pool", "dispatch",
+                                      seq=item[1][0], base=item[1][1])
                     break
                 except TimeoutError:
                     continue
@@ -1552,6 +1580,10 @@ class Pool:
         )
         self._store_fallbacks += 1
         _m_store_fallbacks.inc()
+        FLIGHT.record("store", "storemiss", seq=seq, base=base,
+                      ident=ident.hex()[:8],
+                      reason="worker could not resolve refs; "
+                             "resending inline")
         logger.warning(
             "store: worker %s could not resolve refs (seq=%d base=%d); "
             "resending chunk inline", ident.hex()[:8], seq, base)
@@ -1648,6 +1680,19 @@ class Pool:
 
         return export.write_chrome_trace(path, tracing.SPANS.snapshot())
 
+    def flight_dump(self, path: str) -> str:
+        """Write this process's flight-recorder buffer (pool submits and
+        dispatches, scheduler decisions, store/transport/health
+        anomalies) as JSON — the companion artifact ``fiber-tpu
+        explain`` joins with the trace. Returns ``path``."""
+        import json
+
+        with open(path, "w") as fh:
+            json.dump({"host": tracing.host_id(), "pid": os.getpid(),
+                       "dropped": FLIGHT.dropped,
+                       "events": FLIGHT.snapshot()}, fh, default=str)
+        return path
+
     # -- submission --------------------------------------------------------
     def _submit(
         self,
@@ -1692,6 +1737,8 @@ class Pool:
         # (docs/observability.md). Unsampled maps ship tctx=None and the
         # workers record nothing.
         trace_id = telemetry.maybe_start_trace()
+        FLIGHT.record("pool", "submit", seq=seq, items=len(items),
+                      trace=trace_id)
         root_span = (tracing.span("pool.serialize", trace=trace_id,
                                   seq=seq, items=len(items))
                      if trace_id else contextlib.nullcontext())
@@ -2113,7 +2160,17 @@ class ResilientPool(Pool):
         """Failure-detector declaration: treat the silent ident exactly
         like a reported death (resubmit its pending chunks, block
         future handouts to it). Runs on the detector thread."""
+        host = self._ident_hosts.get(ident)
         n = self._reclaim_ident(ident)
+        if FLIGHT.enabled:
+            # Black-box capture off the detector thread: the master's
+            # own flight view of the dead ident, plus a best-effort pull
+            # of the peer host's postmortem op (docs/observability.md).
+            threading.Thread(
+                target=self._capture_postmortem,
+                args=(ident, host, n, "suspect"),
+                name="fiber-postmortem", daemon=True,
+            ).start()
         if n:
             logger.warning(
                 "health: worker ident %s silent past suspect_timeout; "
@@ -2130,6 +2187,39 @@ class ResilientPool(Pool):
                 "health: idle worker ident %s silent past "
                 "suspect_timeout; declared dead (nothing to resubmit)",
                 ident.hex()[:8])
+
+    def _capture_postmortem(self, ident: bytes, host, resubmitted: int,
+                            reason: str) -> None:
+        """Write the black-box bundle for one declared-dead worker: the
+        master's flight events (which carry the ident's dispatch /
+        resubmit history) plus, when the backend knows the peer's host,
+        that host agent's ``postmortem`` op — its flight buffer, stack
+        dump and any crash bundles workers on that host flushed.
+        Entirely best-effort: postmortem capture must never take the
+        health plane down with it."""
+        from fiber_tpu.telemetry import postmortem
+
+        peer = None
+        if host is not None:
+            try:
+                from fiber_tpu.backends import get_backend
+
+                collect = getattr(get_backend(), "collect_postmortem",
+                                  None)
+                if collect is not None:
+                    peer = collect(host)
+            except Exception:  # noqa: BLE001 - peer pull is optional
+                logger.warning("postmortem: peer pull for %s failed",
+                               host, exc_info=True)
+        try:
+            path = postmortem.capture_and_write(
+                reason, ident=ident.hex(), peer_host=host,
+                chunks_resubmitted=resubmitted, peer=peer)
+            logger.warning("postmortem: bundle for worker %s written "
+                           "to %s", ident.hex()[:8], path)
+        except Exception:  # noqa: BLE001
+            logger.warning("postmortem: bundle write failed",
+                           exc_info=True)
 
     def _mark_ident_dead(self, ident: bytes) -> None:
         # Caller holds _pending_lock.
@@ -2242,6 +2332,9 @@ class ResilientPool(Pool):
                 global_timer.add("pool.dispatch",
                                  time.perf_counter() - t0)
                 _m_chunks_dispatched.inc()
+                if FLIGHT.enabled:
+                    FLIGHT.record("pool", "dispatch", seq=key[0],
+                                  base=key[1], ident=ident.hex()[:8])
                 _g_queue_depth.set(self._taskq.qsize())
                 # Service-time clock starts at the successful handout;
                 # the speculation monitor ages this entry.
@@ -2449,6 +2542,9 @@ class ResilientPool(Pool):
         if requeued:
             self._n_resubmitted += requeued
             _m_chunks_resubmitted.inc(requeued)
+            FLIGHT.record("pool", "resubmit", ident=ident.hex()[:8],
+                          chunks=requeued,
+                          reason="worker death / suspect reclaim")
         return requeued
 
     def _on_subworker_death(self, ident: bytes) -> None:

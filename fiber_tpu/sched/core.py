@@ -47,6 +47,7 @@ from collections import deque
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from fiber_tpu import telemetry
+from fiber_tpu.telemetry.flightrec import FLIGHT
 from fiber_tpu.utils.logging import get_logger
 
 logger = get_logger()
@@ -339,6 +340,13 @@ class Scheduler:
                 digests = st.digests.get(key)
         if duration is not None:
             _h_chunk_duration.observe(duration)
+            if FLIGHT.enabled:
+                # Per-chunk service time (handout -> result): the
+                # explain layer's straggler signal — outliers vs the
+                # map's median are the blamed seconds.
+                FLIGHT.record("sched", "chunk_done", seq=key[0],
+                              base=key[1], dur=round(duration, 6),
+                              host=host)
         if digests:
             # Organic locality learning: the completing host resolved
             # (and its store tier now caches) these objects.
@@ -483,6 +491,11 @@ class Scheduler:
                                                             _EMPTY_SET):
             self.decisions["locality"] += 1
             _m_decisions.inc(kind="locality")
+            if FLIGHT.enabled:
+                FLIGHT.record(
+                    "sched", "locality", seq=item[1][0], base=item[1][1],
+                    host=host,
+                    reason=f"host caches {len(digs)} ref digest(s)")
         return item
 
     # -- straggler speculation --------------------------------------------
@@ -538,6 +551,11 @@ class Scheduler:
                     self._ring.append(key[0])
                 self._speculated.add(key)
                 self.decisions["speculate"] += 1
+                FLIGHT.record(
+                    "sched", "speculate", seq=key[0], base=key[1],
+                    age=round(now - min(holders.values()), 4),
+                    reason=(f"age > {self._quantile:g}x median "
+                            f"{median:.4f}s with {idle} idle worker(s)"))
                 fired += 1
                 idle -= 1
                 if idle <= 0:

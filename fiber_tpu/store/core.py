@@ -38,6 +38,7 @@ from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 from fiber_tpu import serialization
+from fiber_tpu.telemetry.flightrec import FLIGHT
 from fiber_tpu.utils.logging import get_logger
 
 logger = get_logger()
@@ -167,6 +168,9 @@ class LocalStore:
             self._entries[digest] = _Entry(data, refs, on_disk)
             self._ram_bytes += len(data)
             self._stats["puts"] += 1
+            if FLIGHT.enabled:
+                FLIGHT.record("store", "put", digest=digest[:8],
+                              bytes=len(data))
             self._evict_locked()
         if persist and self.root is not None \
                 and self._write_disk(digest, data):
@@ -279,6 +283,9 @@ class LocalStore:
                     entry.on_disk = True
                     self._stats["spills"] += 1
                     self._stats["spill_bytes"] += len(entry.data)
+                    FLIGHT.record("store", "spill", digest=digest[:8],
+                                  bytes=len(entry.data),
+                                  reason="RAM tier over capacity")
             del self._entries[digest]
             self._ram_bytes -= len(entry.data)
             self._stats["evictions"] += 1

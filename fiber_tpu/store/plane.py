@@ -43,6 +43,7 @@ from typing import Any, Dict, Optional, Tuple
 
 from fiber_tpu import serialization, telemetry
 from fiber_tpu.store.core import LocalStore, ObjectRef, digest_of
+from fiber_tpu.telemetry.flightrec import FLIGHT
 from fiber_tpu.testing import chaos
 from fiber_tpu.transport import Endpoint, TransportClosed
 from fiber_tpu.utils.logging import get_logger
@@ -338,11 +339,19 @@ class StoreClient:
                 self._count("fetch_failures")
                 raise StoreFetchError(str(err)) from err
         last_err: Optional[BaseException] = None
+        t0 = time.perf_counter()
         for attempt in range(2):
             try:
                 data = self._fetch_once(ref, fresh=attempt > 0)
                 self._count("wire_fetches")
                 self._count("wire_bytes", len(data))
+                if FLIGHT.enabled:
+                    # wire=True marks a LOCALITY MISS for explain: the
+                    # payload was fetched where it did not already live.
+                    FLIGHT.record(
+                        "store", "fetch", digest=ref.digest[:8],
+                        bytes=len(data), wire=True,
+                        s=round(time.perf_counter() - t0, 4))
                 return data
             except StoreFetchError:
                 raise  # definitive (miss / digest mismatch): no retry
@@ -350,6 +359,8 @@ class StoreClient:
                 last_err = err
                 self._drop_conn(ref.owner)
         self._count("fetch_failures")
+        FLIGHT.record("store", "fetch_fail", digest=ref.digest[:8],
+                      owner=str(ref.owner), reason=repr(last_err))
         raise StoreFetchError(
             f"fetch of {ref.digest[:12]} from {ref.owner} failed: "
             f"{last_err!r}")

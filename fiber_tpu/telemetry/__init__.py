@@ -35,6 +35,7 @@ from fiber_tpu.telemetry.metrics import (  # noqa: F401
     merge_snapshots,
 )
 from fiber_tpu.telemetry import tracing  # noqa: F401
+from fiber_tpu.telemetry.flightrec import FLIGHT  # noqa: F401
 from fiber_tpu.telemetry.tracing import (  # noqa: F401
     SPANS,
     current_trace_id,
@@ -94,6 +95,12 @@ def refresh() -> None:
     _sample_rate = max(0.0, min(1.0, float(cfg.trace_sample_rate)))
     if SPANS._spans.maxlen != int(cfg.span_buffer_size):
         SPANS.resize(int(cfg.span_buffer_size))
+    # Flight recorder rides the same master switch plus its own knob
+    # (docs/observability.md).
+    FLIGHT.enabled = bool(cfg.telemetry_enabled) \
+        and bool(cfg.flightrec_enabled)
+    if FLIGHT._events.maxlen != int(cfg.flightrec_buffer_size):
+        FLIGHT.resize(int(cfg.flightrec_buffer_size))
 
 
 def snapshot() -> Dict[str, Any]:
@@ -120,6 +127,9 @@ def snapshot() -> Dict[str, Any]:
         "timers": global_timer.stats(),
         "spans_buffered": len(SPANS),
         "spans_dropped": SPANS.dropped,
+        "flight_buffered": len(FLIGHT),
+        "flight_recorded": FLIGHT.recorded,
+        "flight_dropped": FLIGHT.dropped,
         "sched": sched_snaps,
     }
 

@@ -1,0 +1,228 @@
+"""``fiber-tpu explain``: join spans + flight events and classify where
+a map's time went (docs/observability.md).
+
+Tracing (PR 3) answers *what happened*: the spans of one trace id show
+serialize → dispatch → resolve-refs → execute → result across the
+cluster. This module answers *why was it slow*, by joining those spans
+with the flight recorder's decision/anomaly events and attributing
+seconds to the five blame categories the training/inference stacks
+debug daily:
+
+==================  =====================================================
+straggler           excess service time of outlier chunks — per-chunk
+                    handout→result durations (``sched``/``chunk_done``
+                    events, falling back to execute-span durations) above
+                    ``quantile`` x the map's median; ``speculate`` events
+                    are corroborating evidence
+store_fetch         worker-side ref resolution (``worker.resolve_refs``
+                    span durations)
+locality_miss       the subset of store traffic that crossed the wire
+                    (``store``/``fetch`` events with ``wire=True``) —
+                    payload fetched where it did NOT already live
+backpressure        submit-side waits on the in-flight cap
+                    (``pool``/``backpressure`` events, ``wait_s``)
+transport_stall     ingress stalls/parks observed by either I/O engine
+                    (``transport``/``stall`` + ``park`` events)
+==================  =====================================================
+
+The verdict is a **ranked budget**: seconds attributed per category,
+plus ``primary`` — the top category with nonzero blame (or
+``"compute"`` when nothing above explains the wall clock, i.e. the map
+was simply busy). All inputs are artifacts (the Chrome trace written by
+``Pool.trace_dump`` / ``bench.py --cluster`` and the flight-event JSON
+from ``Pool.flight_dump``), so the CLI runs offline against any
+recorded run.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: Blame categories, ranked in the verdict (compute is context, not
+#: blame — it appears in the budget but never as primary unless nothing
+#: else has weight).
+CATEGORIES = ("straggler", "store_fetch", "locality_miss",
+              "backpressure", "transport_stall")
+
+
+def spans_from_chrome(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Invert export.chrome_trace: complete (``ph == "X"``) events back
+    into span dicts (ts/dur in seconds, args flattened)."""
+    pid_to_host = {
+        e.get("pid"): e.get("args", {}).get("name")
+        for e in doc.get("traceEvents", [])
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    spans = []
+    for e in doc.get("traceEvents", []):
+        if e.get("ph") != "X":
+            continue
+        sp = dict(e.get("args") or {})
+        sp["name"] = e.get("name", "span")
+        sp["ts"] = float(e.get("ts", 0.0)) / 1e6
+        sp["dur"] = float(e.get("dur", 0.0)) / 1e6
+        sp.setdefault("host", pid_to_host.get(e.get("pid"), "host"))
+        sp.setdefault("pid", e.get("tid", 0))
+        spans.append(sp)
+    return spans
+
+
+def load_spans(path: str) -> List[Dict[str, Any]]:
+    """Spans from a file: a Chrome trace-event JSON object (trace_dump
+    output) or a plain JSON list of span dicts."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        return spans_from_chrome(doc)
+    if isinstance(doc, list):
+        return doc
+    raise ValueError(f"{path!r} holds neither a Chrome trace nor a "
+                     "span list")
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    """Flight events from a file: a JSON list, or the ``Pool.flight_dump``
+    envelope ``{"events": [...]}``."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if isinstance(doc, dict):
+        doc = doc.get("events", [])
+    if not isinstance(doc, list):
+        raise ValueError(f"{path!r} holds no flight-event list")
+    return doc
+
+
+def _dominant_trace(spans: Sequence[Dict[str, Any]]) -> Optional[str]:
+    counts: Dict[str, int] = {}
+    for sp in spans:
+        tid = sp.get("trace")
+        if tid:
+            counts[tid] = counts.get(tid, 0) + 1
+    return max(counts, key=counts.get) if counts else None
+
+
+def _median(values: Sequence[float]) -> float:
+    ordered = sorted(values)
+    return ordered[len(ordered) // 2] if ordered else 0.0
+
+
+def explain_trace(spans: Sequence[Dict[str, Any]],
+                  events: Iterable[Dict[str, Any]] = (),
+                  trace_id: Optional[str] = None,
+                  quantile: float = 2.0) -> Dict[str, Any]:
+    """Classify one trace's time. ``trace_id`` defaults to the trace
+    with the most spans (the artifact usually holds exactly the traced
+    map plus stragglers of earlier ones)."""
+    trace_id = trace_id or _dominant_trace(spans)
+    mine = [sp for sp in spans if sp.get("trace") == trace_id]
+    if not mine:
+        raise ValueError(f"no spans for trace {trace_id!r}")
+    t0 = min(float(sp.get("ts", 0.0)) for sp in mine)
+    t1 = max(float(sp.get("ts", 0.0)) + float(sp.get("dur", 0.0))
+             for sp in mine)
+    seqs = {sp["seq"] for sp in mine if sp.get("seq") is not None}
+
+    def in_scope(ev: Dict[str, Any]) -> bool:
+        seq = ev.get("seq")
+        if seq is not None and seqs:
+            return seq in seqs
+        # seq-less events (transport, store wire traffic) join by time:
+        # the trace window plus a little slack for clock skew.
+        return t0 - 0.5 <= float(ev.get("ts", 0.0)) <= t1 + 0.5
+
+    scoped = [ev for ev in events if in_scope(ev)]
+
+    budget: Dict[str, float] = {c: 0.0 for c in CATEGORIES}
+    evidence: Dict[str, Any] = {"trace": trace_id,
+                                "seqs": sorted(seqs),
+                                "events_considered": len(scoped)}
+
+    execute = [sp for sp in mine if sp.get("name") == "worker.execute"]
+    budget["compute"] = sum(float(sp.get("dur", 0.0)) for sp in execute)
+    budget["store_fetch"] = sum(
+        float(sp.get("dur", 0.0)) for sp in mine
+        if sp.get("name") == "worker.resolve_refs")
+    budget["serialize"] = sum(
+        float(sp.get("dur", 0.0)) for sp in mine
+        if sp.get("name") == "pool.serialize")
+
+    # Straggler: per-chunk service times (handout -> result) from the
+    # scheduler's chunk_done events; execute spans are the fallback for
+    # artifacts recorded without the flight recorder. Blame is the
+    # EXCESS above quantile x median — a uniformly slow map is compute,
+    # not a straggler.
+    durs = [float(ev.get("dur", 0.0)) for ev in scoped
+            if ev.get("plane") == "sched" and ev.get("kind") == "chunk_done"]
+    dur_source = "sched.chunk_done"
+    if not durs:
+        durs = [float(sp.get("dur", 0.0)) for sp in execute]
+        dur_source = "worker.execute"
+    median = _median(durs)
+    threshold = max(quantile, 1.0) * median
+    excess = [d - threshold for d in durs if d > threshold]
+    budget["straggler"] = sum(excess)
+    speculated = sum(1 for ev in scoped
+                     if ev.get("plane") == "sched"
+                     and ev.get("kind") == "speculate")
+    evidence["straggler"] = {
+        "chunks": len(durs), "median_s": round(median, 6),
+        "outliers": len(excess), "speculations": speculated,
+        "source": dur_source,
+    }
+
+    wire_fetches = [ev for ev in scoped
+                    if ev.get("plane") == "store"
+                    and ev.get("kind") == "fetch" and ev.get("wire")]
+    budget["locality_miss"] = sum(float(ev.get("s", 0.0))
+                                  for ev in wire_fetches)
+    evidence["locality_miss"] = {
+        "wire_fetches": len(wire_fetches),
+        "bytes": sum(int(ev.get("bytes", 0)) for ev in wire_fetches),
+    }
+
+    budget["backpressure"] = sum(
+        float(ev.get("wait_s", 0.0)) for ev in scoped
+        if ev.get("plane") == "pool" and ev.get("kind") == "backpressure")
+    budget["transport_stall"] = sum(
+        float(ev.get("stall_s", 0.0)) for ev in scoped
+        if ev.get("plane") == "transport"
+        and ev.get("kind") in ("stall", "park"))
+
+    ranked = sorted(((c, budget[c]) for c in CATEGORIES),
+                    key=lambda kv: kv[1], reverse=True)
+    primary = ranked[0][0] if ranked[0][1] > 0.0 else "compute"
+    return {
+        "trace": trace_id,
+        "wall_s": round(t1 - t0, 6),
+        "spans": len(mine),
+        "budget": {k: round(v, 6) for k, v in budget.items()},
+        "ranked": [(c, round(s, 6)) for c, s in ranked],
+        "primary": primary,
+        "evidence": evidence,
+    }
+
+
+def render(verdict: Dict[str, Any]) -> str:
+    """Human-readable ranked budget (the CLI's output)."""
+    lines = [
+        f"trace {verdict['trace']}  wall {verdict['wall_s']:.3f}s  "
+        f"spans {verdict['spans']}",
+        f"primary: {verdict['primary']}",
+        "ranked budget (blame seconds):",
+    ]
+    for cat, secs in verdict["ranked"]:
+        lines.append(f"  {cat:<16} {secs:.4f}")
+    budget = verdict["budget"]
+    lines.append(f"  {'compute':<16} {budget.get('compute', 0.0):.4f}"
+                 "  (context, not blame)")
+    if "serialize" in budget:
+        lines.append(f"  {'serialize':<16} "
+                     f"{budget['serialize']:.4f}  (context)")
+    ev = verdict.get("evidence", {}).get("straggler")
+    if ev:
+        lines.append(
+            f"straggler evidence: {ev['outliers']}/{ev['chunks']} outlier "
+            f"chunk(s) vs median {ev['median_s']:.4f}s, "
+            f"{ev['speculations']} speculation(s) [{ev['source']}]")
+    return "\n".join(lines)

@@ -1,0 +1,232 @@
+"""Postmortem capture: black-box bundles for dead or dying processes
+(docs/observability.md).
+
+A **bundle** is one JSON file answering "what was that process doing
+when it died": the process's flight-recorder events
+(:mod:`fiber_tpu.telemetry.flightrec`), a ``faulthandler``-style
+all-thread stack dump, and identity/reason metadata. Three producers
+write them, all under ``<staging root>/postmortem/`` — the same
+agent-servable root the object store and code staging use, so the host
+agent can ship bundles to the operator without widening its file-op
+confinement:
+
+* **workers** install :func:`install_crash_handler` (pool worker
+  bootstrap): SIGTERM/SIGABRT flush a bundle before the process dies,
+  and the chaos harness's hard-kill (``os._exit``) calls
+  :func:`crash_flush` first — modeling a real flight recorder's
+  survive-the-crash property;
+* **the health plane** (``ResilientPool._on_peer_suspect``): when the
+  failure detector declares a worker dead, the master writes a bundle
+  with its own view of the dead ident and best-effort pulls the peer
+  host's ``postmortem`` agent op into it;
+* **operators**: ``fiber-tpu postmortem`` lists/prints bundles locally
+  or pulls them from agents.
+
+Bundles are bounded: the newest :data:`MAX_BUNDLES` are kept per
+directory, oldest pruned at write time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+from fiber_tpu.telemetry.flightrec import FLIGHT
+
+#: Bundle files kept per postmortem directory before the oldest are
+#: pruned (each is a few KB; a crash-looping worker must not fill the
+#: staging disk).
+MAX_BUNDLES = 64
+
+SCHEMA = "fiber-postmortem-v1"
+
+_BUNDLE_PREFIX = "pm-"
+
+
+def bundle_dir(root: Optional[str] = None) -> str:
+    """Where bundles land: ``<staging root>/postmortem`` (the staging
+    root is FIBER_AGENT_STAGING or ~/.fiber_tpu/staging — the directory
+    the host agent already serves and polices)."""
+    if root is None:
+        from fiber_tpu.host_agent import default_staging_root
+
+        root = default_staging_root()
+    return os.path.join(root, "postmortem")
+
+
+def stack_dump() -> str:
+    """All-thread stack dump. Prefers ``faulthandler`` (the
+    async-signal-safe canonical form); falls back to a pure-Python walk
+    of ``sys._current_frames`` when faulthandler can't take a file
+    (some embedders)."""
+    try:
+        import faulthandler
+
+        with tempfile.TemporaryFile(mode="w+") as fh:
+            faulthandler.dump_traceback(file=fh, all_threads=True)
+            fh.seek(0)
+            return fh.read()
+    except Exception:  # noqa: BLE001 - the dump must never fail capture
+        try:
+            import threading
+
+            names = {t.ident: t.name for t in threading.enumerate()}
+            lines: List[str] = []
+            for tid, frame in sys._current_frames().items():
+                lines.append(f"Thread {names.get(tid, tid)}:")
+                lines.extend(
+                    ln.rstrip() for ln in traceback.format_stack(frame))
+            return "\n".join(lines)
+        except Exception:  # noqa: BLE001
+            return "<stack dump unavailable>"
+
+
+def capture(reason: str, ident: Optional[str] = None,
+            **extra: Any) -> Dict[str, Any]:
+    """Build one bundle dict from this process's state (no I/O)."""
+    from fiber_tpu.telemetry import tracing
+
+    bundle: Dict[str, Any] = {
+        "schema": SCHEMA,
+        "reason": str(reason),
+        "host": tracing.host_id(),
+        "pid": os.getpid(),
+        "ts": time.time(),
+        "flight": FLIGHT.snapshot(),
+        "flight_dropped": FLIGHT.dropped,
+        "stacks": stack_dump(),
+    }
+    if ident is not None:
+        bundle["ident"] = ident
+    if extra:
+        bundle.update(extra)
+    return bundle
+
+
+def _prune(directory: str) -> None:
+    try:
+        names = sorted(n for n in os.listdir(directory)
+                       if n.startswith(_BUNDLE_PREFIX))
+    except OSError:
+        return
+    for name in names[:-MAX_BUNDLES]:
+        try:
+            os.unlink(os.path.join(directory, name))
+        except OSError:
+            pass
+
+
+def write_bundle(bundle: Dict[str, Any],
+                 directory: Optional[str] = None) -> str:
+    """Write one bundle as JSON under ``directory`` (default:
+    :func:`bundle_dir`); returns the path. Atomic rename so a reader
+    (the agent's postmortem op) never sees a torn file."""
+    directory = directory or bundle_dir()
+    os.makedirs(directory, exist_ok=True)
+    name = (f"{_BUNDLE_PREFIX}{bundle.get('host', 'host')}-"
+            f"{bundle.get('pid', 0)}-{int(bundle.get('ts', 0) * 1000)}"
+            ".json")
+    path = os.path.join(directory, name)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as fh:
+            json.dump(bundle, fh, default=str)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _prune(directory)
+    return path
+
+
+def capture_and_write(reason: str, ident: Optional[str] = None,
+                      directory: Optional[str] = None,
+                      **extra: Any) -> str:
+    return write_bundle(capture(reason, ident=ident, **extra), directory)
+
+
+def list_bundles(directory: Optional[str] = None) -> List[str]:
+    """Bundle paths under ``directory``, oldest first."""
+    directory = directory or bundle_dir()
+    try:
+        names = sorted(n for n in os.listdir(directory)
+                       if n.startswith(_BUNDLE_PREFIX)
+                       and n.endswith(".json"))
+    except OSError:
+        return []
+    return [os.path.join(directory, n) for n in names]
+
+
+def read_bundle(path: str) -> Dict[str, Any]:
+    with open(path) as fh:
+        return json.load(fh)
+
+
+# ---------------------------------------------------------------------------
+# Crash handler (worker side)
+# ---------------------------------------------------------------------------
+
+_installed = False
+_flushed = False
+
+
+def crash_flush(reason: str) -> Optional[str]:
+    """Flush this process's bundle if (and only if) the crash handler
+    is installed — the seam the chaos harness's hard-kill calls before
+    ``os._exit``, since no signal ever fires there. Idempotent: the
+    first flush wins (a SIGTERM racing an explicit flush must not write
+    two bundles for one death)."""
+    global _flushed
+    if not _installed or _flushed:
+        return None
+    _flushed = True
+    try:
+        return capture_and_write(reason)
+    except Exception:  # noqa: BLE001 - dying anyway; never mask the exit
+        return None
+
+
+def install_crash_handler() -> bool:
+    """Arm SIGTERM/SIGABRT bundle flushing for this process (pool
+    worker bootstrap calls this when the flight recorder is on). The
+    handler writes the bundle, restores the previous disposition and
+    re-raises the signal so the observable death semantics — exit code,
+    core dumps, parent reaping — are untouched. Main-thread only (the
+    signal module's rule); returns False when it can't install."""
+    global _installed
+    if _installed:
+        return True
+    import signal
+    import threading
+
+    if threading.current_thread() is not threading.main_thread():
+        return False
+
+    def make_handler(signum, prev):
+        def handler(_sig, _frame):
+            crash_flush(f"signal-{signal.Signals(signum).name}")
+            try:
+                signal.signal(signum, prev if callable(prev)
+                              or prev in (signal.SIG_IGN, signal.SIG_DFL)
+                              else signal.SIG_DFL)
+            except (OSError, ValueError):
+                pass
+            os.kill(os.getpid(), signum)
+        return handler
+
+    try:
+        for signum in (signal.SIGTERM, signal.SIGABRT):
+            prev = signal.getsignal(signum)
+            signal.signal(signum, make_handler(signum, prev))
+    except (OSError, ValueError):
+        return False
+    _installed = True
+    return True

@@ -221,6 +221,16 @@ class ChaosPlan:
         if (self.kill_after_chunks
                 and completed_chunks == self.kill_after_chunks
                 and self.acquire("kill", self.kill_times)):
+            # Flight-recorder contract: the black box survives the
+            # crash. os._exit fires no signal and no atexit, so the
+            # postmortem flush happens HERE — a no-op unless the worker
+            # armed its crash handler (docs/observability.md).
+            try:
+                from fiber_tpu.telemetry import postmortem
+
+                postmortem.crash_flush("chaos-kill")
+            except Exception:
+                pass
             os._exit(CHAOS_EXIT_CODE)
 
     def maybe_hang_worker(self, completed_chunks: int) -> None:

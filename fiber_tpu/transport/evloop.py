@@ -73,6 +73,17 @@ _m_flush_bytes = telemetry.histogram(
     "transport_evloop_flush_bytes",
     "Bytes accepted by the kernel per sendmsg flush",
     buckets=(64, 1024, 16384, 65536, 262144, 1 << 20, 8 << 20))
+_m_turn_seconds = telemetry.histogram(
+    "transport_evloop_turn_seconds",
+    "Active processing per selector-loop turn (select sleep excluded)",
+    buckets=(1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 5e-3,
+             2.5e-2, 0.1, 1.0))
+# Same instrument transport/tcp.py registers at enqueue time (the
+# registry folds same-name lookups): the loop decrements as it drains.
+_g_txq_bytes = telemetry.gauge(
+    "transport_evloop_tx_queue_bytes",
+    "Bytes queued for the selector loop's coalescing flush, all "
+    "channels")
 
 #: iovec entries per sendmsg call; Linux UIO_MAXIOV is 1024 — stay under.
 _IOV_MAX = 512
@@ -165,6 +176,7 @@ class EventLoop:
                         pass
                     finally:
                         chan._txq.clear()
+                        _g_txq_bytes.dec(chan._tx_bytes)
                         chan._tx_bytes = 0
                         try:
                             chan.sock.setblocking(False)
@@ -217,6 +229,7 @@ class EventLoop:
             else:
                 self._in_select = True
         events = self._selector.select(timeout)
+        t_active = time.perf_counter()
         _m_wakeups.inc()
         wake_ready = any(key.data is None for key, _mask in events)
         if wake_ready:
@@ -275,6 +288,10 @@ class EventLoop:
                     # put_many would still notify — spuriously waking
                     # the consumer once per turn of a large transfer.
                     owner._inbox.put_many(items)
+        # Poller health (docs/observability.md): how long each turn
+        # held the loop — a fat tail here means one channel's work is
+        # delaying every other channel's ingress.
+        _m_turn_seconds.observe(time.perf_counter() - t_active)
 
     # -- registration -----------------------------------------------------
     def _add(self, chan) -> None:
@@ -312,6 +329,7 @@ class EventLoop:
         chan._tx_head.clear()
         with chan._tx_cond:
             chan._txq.clear()
+            _g_txq_bytes.dec(chan._tx_bytes)
             chan._tx_bytes = 0
             chan._tx_inflight = False
             chan._tx_cond.notify_all()
@@ -484,6 +502,8 @@ class EventLoop:
             _m_flush_bytes.observe(sent_total)
         with chan._tx_cond:
             chan._tx_bytes -= sent_total
+            if sent_total:
+                _g_txq_bytes.dec(sent_total)
             chan._tx_inflight = bool(head)
             pending = bool(head) or bool(chan._txq)
             chan._tx_cond.notify_all()

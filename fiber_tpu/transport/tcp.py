@@ -53,6 +53,24 @@ _m_frames_rx = telemetry.counter("transport_frames_rx", "Frames received")
 _m_connect_retries = telemetry.counter(
     "transport_connect_retries",
     "connect() attempts that failed and were retried")
+# Selector-engine egress queue surface (docs/transport.md): aggregate
+# queued bytes across every channel's write queue, the high-water mark
+# ever observed, and how often a sender blocked at the TX_HIGH_WATER
+# gate. Aggregates (not per-channel labels): a pod-scale master has an
+# unbounded channel-id stream that would instantly fold into the
+# registry's overflow series; exact per-channel depth remains readable
+# on the channel objects.
+_g_txq_bytes = telemetry.gauge(
+    "transport_evloop_tx_queue_bytes",
+    "Bytes queued for the selector loop's coalescing flush, all "
+    "channels")
+_g_txq_peak = telemetry.gauge(
+    "transport_evloop_tx_queue_peak_bytes",
+    "High-water mark of any single channel's egress queue")
+_m_txq_highwater_waits = telemetry.counter(
+    "transport_evloop_tx_highwater_waits",
+    "Sends that blocked on the per-channel TX_HIGH_WATER gate")
+_txq_peak_seen = 0  # unlocked monotone max; races only under-report
 
 #: Wire overhead per frame: 8-byte length header + 1-byte type prefix.
 _FRAME_OVERHEAD = 9
@@ -232,8 +250,16 @@ class _Channel:
                 and self.owner.mode == "r"):
             stall_s, drop = plan.recv_frame_actions(self)
             if stall_s > 0.0:
+                from fiber_tpu.telemetry.flightrec import FLIGHT
+
                 if defer_stall:
+                    # The selector loop PARKS this one channel instead
+                    # of sleeping the poller (evloop._readable).
+                    FLIGHT.record("transport", "park",
+                                  stall_s=stall_s, cid=self.cid)
                     return (stall_s, drop)
+                FLIGHT.record("transport", "stall",
+                              stall_s=stall_s, cid=self.cid)
                 time.sleep(stall_s)
             if drop:
                 # Dropped: model LOSS, not throttling — hand the
@@ -305,17 +331,26 @@ class _Channel:
         remainder is left for the poller."""
         from fiber_tpu.transport.evloop import TX_HIGH_WATER
 
+        global _txq_peak_seen
         loop = self._loop
         with self._tx_cond:
             if not self.alive or self._tx_closing:
                 raise TransportClosed("channel closed")
             if (self._tx_bytes > TX_HIGH_WATER
                     and threading.current_thread() is not loop.thread):
+                _m_txq_highwater_waits.inc()
+                from fiber_tpu.telemetry.flightrec import FLIGHT
+
+                FLIGHT.record("transport", "highwater",
+                              queued=self._tx_bytes,
+                              reason="egress queue past TX_HIGH_WATER; "
+                                     "sender blocked")
                 while (self._tx_bytes > TX_HIGH_WATER and self.alive
                        and not self._tx_closing):
                     self._tx_cond.wait(0.5)
                 if not self.alive or self._tx_closing:
                     raise TransportClosed("channel closed")
+            queued_bytes = wire_bytes
             if (wire_bytes > SMALL_FRAME_MAX and self._registered
                     and not self._txq and not self._tx_inflight):
                 pieces = self._inline_send(pieces)
@@ -323,8 +358,18 @@ class _Channel:
                     self.bytes_tx += wire_bytes
                     self.frames_tx += 1
                     return
+                # Only the EAGAIN remainder is queued: accounting the
+                # full frame here would inflate _tx_bytes by the
+                # inline-sent portion on every partial send (the flush
+                # only ever decrements what it actually wrote), walking
+                # the queue depth toward a permanent high-water block.
+                queued_bytes = sum(len(p) for p, _end in pieces)
             self._txq.extend(pieces)
-            self._tx_bytes += wire_bytes
+            self._tx_bytes += queued_bytes
+            _g_txq_bytes.inc(queued_bytes)
+            if self._tx_bytes > _txq_peak_seen:
+                _txq_peak_seen = self._tx_bytes
+                _g_txq_peak.set(self._tx_bytes)
             self.bytes_tx += wire_bytes
             self.frames_tx += 1
             dirty = self._tx_dirty
@@ -516,6 +561,10 @@ class Endpoint:
                 if attempt >= retries:
                     raise
                 _m_connect_retries.inc()
+                from fiber_tpu.telemetry.flightrec import FLIGHT
+
+                FLIGHT.record("transport", "retry", addr=addr,
+                              attempt=attempt + 1)
                 time.sleep(min(retry_base * (2 ** attempt), 2.0))
                 attempt += 1
         sock.settimeout(None)

@@ -1,0 +1,392 @@
+"""Flight recorder, postmortem bundles, explain classification, and the
+observability CLI verbs (docs/observability.md)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import fiber_tpu
+from fiber_tpu import telemetry
+from fiber_tpu.telemetry import explain, export, postmortem, tracing
+from fiber_tpu.telemetry.flightrec import FLIGHT, FlightRecorder
+from tests import targets
+
+
+@pytest.fixture(autouse=True)
+def _flight_isolation():
+    """Each test starts with empty flight/span buffers and ends with
+    config overrides dropped (init re-syncs recorder enablement)."""
+    FLIGHT.clear()
+    tracing.SPANS.clear()
+    yield
+    fiber_tpu.init()
+
+
+# ---------------------------------------------------------------------------
+# recorder semantics
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_is_a_bounded_ring():
+    rec = FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.record("pool", "dispatch", i=i)
+    assert len(rec) == 4
+    assert rec.dropped == 6
+    assert rec.recorded == 10
+    assert rec.snapshot()[0]["i"] == 6          # oldest survivor
+    assert [e["i"] for e in rec.snapshot(last=2)] == [8, 9]
+    assert [e["i"] for e in rec.drain()] == [6, 7, 8, 9]
+    assert len(rec) == 0
+    ev = rec.snapshot()
+    assert ev == []
+
+
+def test_disabled_recorder_is_noop():
+    rec = FlightRecorder(enabled=False)
+    rec.record("pool", "dispatch")
+    assert len(rec) == 0
+    assert rec.recorded == 0
+
+
+def test_flightrec_config_knobs_follow_refresh():
+    fiber_tpu.init(flightrec_enabled=False)
+    assert not FLIGHT.enabled
+    fiber_tpu.init(flightrec_buffer_size=7)
+    assert FLIGHT.enabled
+    assert FLIGHT._events.maxlen == 7
+    # telemetry_enabled is the master switch over the whole plane
+    fiber_tpu.init(telemetry_enabled=False)
+    assert not FLIGHT.enabled
+
+
+# ---------------------------------------------------------------------------
+# plane hooks through a real map
+# ---------------------------------------------------------------------------
+
+
+def test_pool_map_emits_flight_events(tmp_path):
+    with fiber_tpu.Pool(2) as pool:
+        pool.map(targets.square, range(64), chunksize=4)
+        dump = pool.flight_dump(str(tmp_path / "flight.json"))
+    kinds = {(e["plane"], e["kind"]) for e in FLIGHT.snapshot()}
+    assert ("pool", "submit") in kinds
+    assert ("pool", "dispatch") in kinds
+    assert ("sched", "chunk_done") in kinds      # explain's straggler feed
+    # the dump artifact is the explain CLI's --flight input
+    with open(dump) as fh:
+        doc = json.load(fh)
+    assert doc["host"] and isinstance(doc["events"], list)
+    assert any(e["kind"] == "submit" for e in doc["events"])
+    # flight state rides the telemetry snapshot beside spans
+    snap = telemetry.snapshot()
+    assert snap["flight_buffered"] >= 1
+    assert snap["flight_recorded"] >= snap["flight_buffered"]
+
+
+def test_store_and_health_hooks_record():
+    from fiber_tpu.health import CircuitBreaker
+    from fiber_tpu.store import LocalStore
+
+    st = LocalStore(capacity_bytes=1 << 20)
+    st.put_bytes(b"x" * 128)
+    breaker = CircuitBreaker(fail_threshold=1, base_backoff=0.01,
+                             max_backoff=0.01)
+    assert breaker.record_failure("hostA")
+    breaker.record_success("hostA")
+    kinds = {(e["plane"], e["kind"]) for e in FLIGHT.snapshot()}
+    assert ("store", "put") in kinds
+    assert ("health", "breaker_open") in kinds
+    assert ("health", "breaker_close") in kinds
+    opened = next(e for e in FLIGHT.snapshot()
+                  if e["kind"] == "breaker_open")
+    assert opened["key"] == "hostA" and opened["backoff_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# postmortem bundles
+# ---------------------------------------------------------------------------
+
+
+def test_capture_and_write_bundle(tmp_path):
+    FLIGHT.record("pool", "chunk", seq=1, base=0)
+    path = postmortem.capture_and_write("unit", ident="aabb",
+                                        directory=str(tmp_path))
+    bundle = postmortem.read_bundle(path)
+    assert bundle["schema"] == postmortem.SCHEMA
+    assert bundle["reason"] == "unit"
+    assert bundle["ident"] == "aabb"
+    assert bundle["host"] == tracing.host_id()
+    assert any(e["kind"] == "chunk" for e in bundle["flight"])
+    # faulthandler format ("Thread 0x...: / File ...") either way
+    assert "File" in bundle["stacks"] or "Thread" in bundle["stacks"]
+    assert postmortem.list_bundles(str(tmp_path)) == [path]
+
+
+def test_bundle_directory_is_pruned(tmp_path):
+    for i in range(postmortem.MAX_BUNDLES + 5):
+        postmortem.write_bundle(
+            {"schema": postmortem.SCHEMA, "host": "h", "pid": i,
+             "ts": float(i)}, str(tmp_path))
+    assert len(postmortem.list_bundles(str(tmp_path))) == \
+        postmortem.MAX_BUNDLES
+
+
+def test_chaos_kill_flushes_worker_black_box(tmp_path):
+    """Acceptance: a chaos-killed worker leaves a postmortem bundle
+    containing its flight events and stack dump — the flight recorder's
+    survive-the-crash contract (the chaos hard-kill calls crash_flush
+    because os._exit fires no signal)."""
+    from fiber_tpu.testing import chaos
+
+    pm_dir = postmortem.bundle_dir()
+    before = set(postmortem.list_bundles(pm_dir))
+    seed = int(os.environ.get("FIBER_CHAOS_SEED", "7"))
+    plan = chaos.install(chaos.ChaosPlan(
+        seed=seed, token_dir=str(tmp_path / "tokens"),
+        kill_after_chunks=2, kill_times=1))
+    try:
+        with fiber_tpu.Pool(2) as pool:
+            xs = list(range(120))
+            assert pool.map(targets.square, xs, chunksize=4) == \
+                [x * x for x in xs]
+    finally:
+        chaos.uninstall()
+    assert plan.spent("kill") == 1
+    new = sorted(set(postmortem.list_bundles(pm_dir)) - before)
+    bundles = []
+    for path in new:
+        try:
+            bundles.append(postmortem.read_bundle(path))
+        except (OSError, ValueError):
+            continue
+    killed = [b for b in bundles if b.get("reason") == "chaos-kill"]
+    assert killed, f"no chaos-kill bundle among {new}"
+    bundle = killed[-1]
+    assert bundle["pid"] != os.getpid()          # written by the worker
+    assert any(e.get("kind") == "chunk" for e in bundle["flight"])
+    assert bundle["stacks"]
+
+
+def test_suspect_declaration_writes_master_bundle():
+    """Health-plane leg: a failure-detector declaration makes the
+    master write a black-box bundle for the dead ident (the agent pull
+    inside it is best-effort and absent on the local backend)."""
+    pm_dir = postmortem.bundle_dir()
+    before = set(postmortem.list_bundles(pm_dir))
+    with fiber_tpu.Pool(2) as pool:
+        pool.map(targets.square, range(8))
+        pool._on_peer_suspect(b"\xde\xad\xbe\xef")
+        deadline = time.time() + 10
+        found = []
+        while time.time() < deadline and not found:
+            new = sorted(set(postmortem.list_bundles(pm_dir)) - before)
+            for path in new:
+                try:
+                    bundle = postmortem.read_bundle(path)
+                except (OSError, ValueError):
+                    continue
+                if bundle.get("reason") == "suspect" \
+                        and bundle.get("ident") == "deadbeef":
+                    found.append(bundle)
+            time.sleep(0.05)
+    assert found, "suspect declaration wrote no bundle"
+    assert found[0]["pid"] == os.getpid()
+
+
+def test_agent_postmortem_op(tmp_path):
+    """The host agent ships its flight buffer, a stack dump, and the
+    crash bundles under its staging root."""
+    from fiber_tpu.backends.tpu import AgentClient
+    from fiber_tpu.host_agent import HostAgent
+
+    postmortem.capture_and_write(
+        "worker-crash", directory=postmortem.bundle_dir(str(tmp_path)))
+    FLIGHT.record("agent", "probe")
+    agent = HostAgent(0, bind="127.0.0.1", staging_root=str(tmp_path))
+    t = threading.Thread(target=agent.serve_forever, daemon=True)
+    t.start()
+    client = AgentClient("127.0.0.1", agent.port)
+    try:
+        pull = client.call("postmortem")
+    finally:
+        client.close()
+        agent.stop()
+    assert pull["pid"] == os.getpid()
+    assert any(e["kind"] == "probe" for e in pull["flight"])
+    assert pull["stacks"]
+    assert len(pull["bundles"]) == 1
+    assert pull["bundles"][0]["reason"] == "worker-crash"
+
+
+# ---------------------------------------------------------------------------
+# explain classification
+# ---------------------------------------------------------------------------
+
+
+def _spans(seq=5, execute_durs=(0.1, 0.1, 0.1)):
+    spans = [{"name": "pool.serialize", "trace": "t1", "span": "s0",
+              "ts": 0.0, "dur": 0.01, "seq": seq}]
+    for i, dur in enumerate(execute_durs):
+        spans.append({"name": "worker.execute", "trace": "t1",
+                      "span": f"s{i+1}", "parent": "s0",
+                      "ts": 0.02, "dur": dur, "seq": seq})
+    return spans
+
+
+def test_explain_blames_the_straggler():
+    events = [
+        {"ts": 0.05, "plane": "sched", "kind": "chunk_done",
+         "seq": 5, "dur": d}
+        for d in (0.1, 0.1, 0.1, 2.1)
+    ] + [{"ts": 1.0, "plane": "sched", "kind": "speculate", "seq": 5,
+          "base": 6, "reason": "age"}]
+    verdict = explain.explain_trace(
+        _spans(execute_durs=(0.1, 0.1, 0.1, 2.0)), events)
+    assert verdict["primary"] == "straggler"
+    assert verdict["budget"]["straggler"] == pytest.approx(1.9)
+    assert verdict["evidence"]["straggler"]["speculations"] == 1
+    assert verdict["ranked"][0][0] == "straggler"
+
+
+def test_explain_blames_backpressure_and_stalls():
+    events = [
+        {"ts": 0.05, "plane": "pool", "kind": "backpressure",
+         "seq": 5, "wait_s": 2.0},
+        {"ts": 0.06, "plane": "transport", "kind": "stall",
+         "stall_s": 0.5},
+        {"ts": 0.07, "plane": "transport", "kind": "park",
+         "stall_s": 0.25},
+    ]
+    verdict = explain.explain_trace(_spans(), events)
+    assert verdict["primary"] == "backpressure"
+    assert verdict["budget"]["transport_stall"] == pytest.approx(0.75)
+    ranked = [c for c, _s in verdict["ranked"]]
+    assert ranked.index("backpressure") < ranked.index("transport_stall")
+
+
+def test_explain_blames_locality_misses():
+    events = [
+        {"ts": 0.05, "plane": "store", "kind": "fetch",
+         "digest": "aa", "bytes": 1 << 20, "wire": True, "s": 0.8},
+        {"ts": 0.06, "plane": "store", "kind": "fetch",
+         "digest": "bb", "bytes": 1 << 20, "wire": False, "s": 0.0},
+    ]
+    verdict = explain.explain_trace(_spans(), events)
+    assert verdict["primary"] == "locality_miss"
+    assert verdict["evidence"]["locality_miss"]["wire_fetches"] == 1
+    assert verdict["evidence"]["locality_miss"]["bytes"] == 1 << 20
+
+
+def test_explain_defaults_to_compute_when_nothing_is_wrong():
+    verdict = explain.explain_trace(_spans(), [])
+    assert verdict["primary"] == "compute"
+
+
+def test_explain_roundtrips_through_chrome_trace(tmp_path):
+    """The classifier reads the SAME Chrome artifact trace_dump writes
+    (pid=host mapping inverted, ts/dur back to seconds)."""
+    path = str(tmp_path / "trace.json")
+    export.write_chrome_trace(path, _spans(execute_durs=(0.1, 0.1, 2.0)))
+    spans = explain.load_spans(path)
+    assert {s["name"] for s in spans} == {"pool.serialize",
+                                          "worker.execute"}
+    verdict = explain.explain_trace(spans, [])
+    assert verdict["primary"] == "straggler"
+    assert verdict["evidence"]["straggler"]["source"] == "worker.execute"
+
+
+# ---------------------------------------------------------------------------
+# CLI verbs
+# ---------------------------------------------------------------------------
+
+
+def test_explain_cli(tmp_path, capsys):
+    from fiber_tpu import cli
+
+    trace = str(tmp_path / "spans.json")
+    with open(trace, "w") as fh:
+        json.dump(_spans(execute_durs=(0.1, 0.1, 0.1)), fh)
+    flight = str(tmp_path / "flight.json")
+    with open(flight, "w") as fh:
+        json.dump({"events": [
+            {"ts": 0.05, "plane": "sched", "kind": "chunk_done",
+             "seq": 5, "dur": d} for d in (0.1, 0.1, 0.1, 3.0)]}, fh)
+    assert cli.main(["explain", trace, "--flight", flight]) == 0
+    out = capsys.readouterr().out
+    assert "primary: straggler" in out
+    assert "ranked budget" in out
+    assert cli.main(["explain", trace, "--flight", flight,
+                     "--json"]) == 0
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["primary"] == "straggler"
+    with pytest.raises(SystemExit):
+        cli.main(["explain", str(tmp_path / "missing.json")])
+
+
+def test_postmortem_cli_local_and_hosts(tmp_path, capsys):
+    from fiber_tpu import cli
+    from fiber_tpu.host_agent import HostAgent
+
+    directory = str(tmp_path / "bundles")
+    postmortem.capture_and_write("chaos-kill", ident="cafe",
+                                 directory=directory)
+    assert cli.main(["postmortem", "--dir", directory]) == 0
+    out = capsys.readouterr().out
+    assert "reason=chaos-kill" in out and "ident=cafe" in out
+    assert cli.main(["postmortem", "--dir", directory, "--json"]) == 0
+    bundles = json.loads(capsys.readouterr().out)
+    assert bundles[0]["ident"] == "cafe"
+    # agent pull path
+    staging = str(tmp_path / "staging")
+    postmortem.capture_and_write(
+        "worker-crash", directory=postmortem.bundle_dir(staging))
+    agent = HostAgent(0, bind="127.0.0.1", staging_root=staging)
+    t = threading.Thread(target=agent.serve_forever, daemon=True)
+    t.start()
+    try:
+        assert cli.main(["postmortem", "--hosts",
+                         f"127.0.0.1:{agent.port}"]) == 0
+        out = capsys.readouterr().out
+        assert "bundles=1" in out
+        assert "reason=worker-crash" in out
+    finally:
+        agent.stop()
+    assert cli.main(["postmortem", "--hosts", "127.0.0.1:1"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# evloop telemetry gap (PR 5 landed after PR 3)
+# ---------------------------------------------------------------------------
+
+
+def test_evloop_turn_and_tx_queue_metrics():
+    """Satellite: the selector engine exports a poller turn-duration
+    histogram and egress queue-depth / high-water gauges through the
+    same registry surface as every other counter."""
+    from fiber_tpu.transport.tcp import Endpoint
+
+    pull = Endpoint("r", io="selector")
+    addr = pull.bind("127.0.0.1")
+    push = Endpoint("w", io="selector").connect(addr)
+    for i in range(64):
+        push.send(b"x" * 64)
+    for _ in range(64):
+        pull.recv(10)
+    snap = telemetry.REGISTRY.snapshot()
+    turn = snap["transport_evloop_turn_seconds"]
+    assert turn["type"] == "histogram"
+    assert turn["series"][""][-1] > 0            # observed turns
+    assert "transport_evloop_tx_queue_bytes" in snap
+    assert "transport_evloop_tx_queue_peak_bytes" in snap
+    assert snap["transport_evloop_tx_queue_peak_bytes"]["series"][""] > 0
+    assert "transport_evloop_tx_highwater_waits" in snap
+    # and they render on the Prometheus surface like everything else
+    text = export.prometheus_text(snap)
+    assert "fiber_transport_evloop_turn_seconds_count" in text
+    assert "fiber_transport_evloop_tx_queue_bytes" in text
+    push.close()
+    pull.close()

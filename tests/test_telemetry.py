@@ -353,6 +353,66 @@ def test_resubmitted_chunks_keep_trace_id(tmp_path):
         "pool_chunks_resubmitted"]["series"][""] >= 1
 
 
+def test_concurrent_wdrr_maps_trace_export_with_speculation(tmp_path):
+    """Satellite (ISSUE 6): trace export under two concurrently active
+    WDRR-interleaved maps with straggler speculation armed — the Chrome
+    artifact stays valid JSON, every execute span (speculative
+    duplicates included) carries its OWN map's trace id, and per-map
+    span counts reconcile with the scheduler's decision counters:
+    chunks <= executes <= chunks + speculations (each speculative
+    duplicate that actually ran adds one execute span to the original
+    trace, never a new trace)."""
+    import os
+
+    from fiber_tpu.testing import chaos
+
+    seed = int(os.environ.get("FIBER_CHAOS_SEED", "7"))
+    plan = chaos.install(chaos.ChaosPlan(
+        seed=seed, token_dir=str(tmp_path / "tokens"),
+        slow_worker_after_chunks=1, slow_worker_s=0.5,
+        slow_worker_times=1))
+    try:
+        fiber_tpu.init(trace_sample_rate=1.0, speculation_enabled=True,
+                       speculation_quantile=2.0)
+        with fiber_tpu.Pool(4) as pool:
+            pool.map(targets.identity, range(4))  # spin-up barrier
+            r1 = pool.map_async(targets.sleep_echo, range(40),
+                                chunksize=2, priority=3.0)
+            r2 = pool.map_async(targets.sleep_echo, range(40),
+                                chunksize=2, priority=1.0)
+            assert r1.get(120) == list(range(40))
+            assert r2.get(120) == list(range(40))
+            execute = _await_spans("worker.execute", 2 + 20 + 20)
+            speculations = pool._sched.decisions["speculate"]
+            path = str(tmp_path / "wdrr_trace.json")
+            pool.trace_dump(path)
+    finally:
+        chaos.uninstall()
+    assert plan.spent("slow") == 1
+    serialize = {s["seq"]: s for s in tracing.SPANS.snapshot()
+                 if s["name"] == "pool.serialize"}
+    map_seqs = [seq for seq, s in serialize.items() if s["items"] == 40]
+    assert len(map_seqs) == 2
+    total_executes = 0
+    for seq in map_seqs:
+        mine = [s for s in execute if s["seq"] == seq]
+        total_executes += len(mine)
+        # one trace id per map, speculative duplicates included
+        assert {s["trace"] for s in mine} == {serialize[seq]["trace"]}
+        assert len(mine) >= 20  # every chunk ran at least once
+    assert total_executes <= 40 + speculations
+    # the Chrome artifact is valid and complete
+    with open(path) as fh:
+        doc = json.load(fh)
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    for event in events:
+        for key in ("name", "ph", "ts", "dur", "pid", "tid"):
+            assert key in event
+    dumped_execs = [e for e in events if e["name"] == "worker.execute"
+                    and e["args"].get("seq") in map_seqs]
+    assert len(dumped_execs) == total_executes
+
+
 # ---------------------------------------------------------------------------
 # structured log context
 # ---------------------------------------------------------------------------

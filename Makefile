@@ -4,7 +4,8 @@
 PYTEST ?= python -m pytest tests/ -q
 
 .PHONY: test stest test-all lint bench bench-store bench-telemetry \
-	bench-sched bench-transport bench-cluster weakscale docs chaos
+	bench-sched bench-transport bench-cluster bench-recovery \
+	weakscale docs chaos
 
 # Tier 1: local backend (subprocess jobs)
 test:
@@ -84,6 +85,15 @@ bench-transport:
 bench-cluster:
 	JAX_PLATFORMS=cpu python bench.py --cluster > BENCH_cluster.json; \
 	rc=$$?; cat BENCH_cluster.json; exit $$rc
+
+# Durable-map recovery gate (docs/robustness.md): write-ahead ledger
+# overhead on the no-crash path (must stay <= 5%) and resume wall-time
+# proportional to the REMAINING tasks of a partially-journaled job,
+# with an exactly-once restored/executed reconciliation. The record
+# lands in BENCH_recovery.json either way.
+bench-recovery:
+	JAX_PLATFORMS=cpu python bench.py --recovery > BENCH_recovery.json; \
+	rc=$$?; cat BENCH_recovery.json; exit $$rc
 
 # Weak-scaling record over 1/2/4/8-device sim meshes (fused ES,
 # population scaled with devices) + strong curve (constant total pop)

@@ -390,6 +390,129 @@ def _sched_bench(args) -> int:
     return 1 if (over or slow) else 0
 
 
+#: `make bench-recovery` gates (docs/robustness.md "Durable maps"): the
+#: write-ahead ledger must cost <= 5% on the NO-CRASH path (the common
+#: case pays for the rare one, bounded), and resuming a 75%-journaled
+#: job must take well under the full run's wall — recovery time scales
+#: with the REMAINING tasks, not the total (Ray's lineage posture:
+#: recompute only what was lost).
+_RECOVERY_OVERHEAD_BUDGET = 1.05
+_RECOVERY_PARTIAL_MAX = 0.6
+
+
+def _recovery_bench(args) -> int:
+    """Durable-map recovery microbench (docs/robustness.md):
+
+    * **overhead** — the signature small-task map with ``job_id=``
+      (full journaling: header fsync + per-chunk result persist +
+      batched record fsyncs) vs without; gated <= 5%;
+    * **proportionality** — complete a ledgered run, truncate its
+      journal to 75% of the chunk records (exactly the state a master
+      crash at that point leaves), resume: the resumed wall must be
+      <= ``_RECOVERY_PARTIAL_MAX`` of the full wall, and the
+      restored/executed split must reconcile to exactly one result per
+      task (ledger + pool counters).
+
+    Best-of-N walls so a CI scheduler hiccup can't fail the gate."""
+    import json as _json
+    import tempfile
+
+    os.environ["FIBER_BACKEND"] = "local"
+    # Private staging root: the bench's ledgers/objects must not land in
+    # (or read from) the operator's real ~/.fiber_tpu.
+    os.environ["FIBER_AGENT_STAGING"] = tempfile.mkdtemp(
+        prefix="fiber-bench-recovery-")
+    import fiber_tpu
+    from fiber_tpu.store import ledger as ledgermod
+
+    workers = 4
+    n_tasks, task_s, chunksize = int(args.recovery_tasks), 0.004, 4
+    reps = max(1, int(args.recovery_reps))
+    fiber_tpu.init(worker_lite=True)
+    uid = os.getpid()
+
+    def run_map(job_id):
+        with fiber_tpu.Pool(workers) as pool:
+            pool.map(_timed_task, [0.0] * workers)  # spin-up barrier
+            before = pool.stats()
+            t0 = time.perf_counter()
+            pool.map(_timed_task, [task_s] * n_tasks,
+                     chunksize=chunksize, job_id=job_id)
+            wall = time.perf_counter() - t0
+            after = pool.stats()
+        # Diff around the timed map so the barrier's tasks don't
+        # pollute the exactly-once reconciliation.
+        stats = {"tasks_completed": (after["tasks_completed"]
+                                     - before["tasks_completed"]),
+                 "tasks_restored": (after["tasks_restored"]
+                                    - before["tasks_restored"])}
+        return wall, stats
+
+    # 1. No-crash ledger overhead (paired reps so box drift cancels).
+    plain = ledgered = None
+    for rep in range(reps):
+        w, _ = run_map(None)
+        plain = w if plain is None else min(plain, w)
+        w, _ = run_map(f"bench-recovery-{uid}-{rep}")
+        ledgered = w if ledgered is None else min(ledgered, w)
+    overhead = round(ledgered / plain, 4)
+    for mode, wall in (("off", plain), ("on", ledgered)):
+        _emit({"metric": f"recovery_ledger_{mode}_tasks_per_sec",
+               "value": round(n_tasks / wall, 1), "unit": "tasks/s",
+               "tasks": n_tasks, "task_s": task_s,
+               "wall_s": round(wall, 4)})
+
+    # 2. Recovery wall proportional to the REMAINING tasks.
+    keep_frac = 0.75
+    full = resume = None
+    restored = executed = 0
+    exact = True
+    for rep in range(reps):
+        job = f"bench-resume-{uid}-{rep}"
+        w_full, _ = run_map(job)
+        path = ledgermod.job_path(job)
+        with open(path) as fh:
+            records = [_json.loads(ln) for ln in fh if ln.strip()]
+        header = [r for r in records if r.get("kind") == "map"]
+        chunks = [r for r in records if r.get("kind") == "chunk"]
+        keep = chunks[:int(len(chunks) * keep_frac)]
+        with open(path, "w") as fh:
+            for rec in header + keep:
+                fh.write(_json.dumps(rec) + "\n")
+        w_resume, stats = run_map(job)
+        restored = stats["tasks_restored"]
+        executed = stats["tasks_completed"]
+        exact = exact and (restored + executed == n_tasks)
+        full = w_full if full is None else min(full, w_full)
+        resume = w_resume if resume is None else min(resume, w_resume)
+    ratio = round(resume / full, 4)
+    fiber_tpu.init()
+    _emit({"metric": "recovery_resume_wall_s", "value": round(resume, 4),
+           "unit": "s", "full_wall_s": round(full, 4),
+           "journaled_frac": keep_frac,
+           "restored_tasks": restored, "executed_tasks": executed})
+    over = overhead > _RECOVERY_OVERHEAD_BUDGET
+    slow = ratio > _RECOVERY_PARTIAL_MAX
+    _emit({"metric": "recovery_gates",
+           "ledger_overhead": overhead,
+           "overhead_budget": _RECOVERY_OVERHEAD_BUDGET,
+           "resume_ratio": ratio, "ratio_max": _RECOVERY_PARTIAL_MAX,
+           "exactly_once": bool(exact),
+           "over_budget": bool(over), "over_ratio": bool(slow)})
+    if over:
+        print(f"FAIL: no-crash ledger overhead {overhead} exceeds "
+              f"budget {_RECOVERY_OVERHEAD_BUDGET}", file=sys.stderr)
+    if slow:
+        print(f"FAIL: resume of a {keep_frac:.0%}-journaled job took "
+              f"{ratio}x the full wall (max {_RECOVERY_PARTIAL_MAX}) — "
+              "recovery is not proportional to the remainder",
+              file=sys.stderr)
+    if not exact:
+        print("FAIL: restored + executed != total tasks — the "
+              "exactly-once ledger contract broke", file=sys.stderr)
+    return 1 if (over or slow or not exact) else 0
+
+
 #: `make bench-cluster` gates (docs/observability.md, ROADMAP item 5):
 #: the full-stack macro bench must sustain this many end-to-end evals
 #: per second through the WHOLE stack at once (sim multi-host pool +
@@ -952,6 +1075,19 @@ def main() -> int:
     parser.add_argument("--cluster-mb", type=float, default=8.0,
                         help="per-generation broadcast size for "
                              "--cluster, MB")
+    parser.add_argument("--recovery", action="store_true",
+                        help="run the durable-map recovery bench instead "
+                             "(docs/robustness.md): no-crash write-ahead "
+                             "ledger overhead (gated <= 5%%) and resume "
+                             "wall proportional to the REMAINING tasks "
+                             "of a 75%%-journaled job, with an "
+                             "exactly-once restored/executed "
+                             "reconciliation. Pure host plane (runs on "
+                             "JAX_PLATFORMS=cpu)")
+    parser.add_argument("--recovery-reps", type=int, default=3,
+                        help="walls per case for --recovery (best-of)")
+    parser.add_argument("--recovery-tasks", type=int, default=240,
+                        help="tasks per map for --recovery")
     parser.add_argument("--profile", default="",
                         help="write a jax.profiler trace of the timed ES "
                              "section to this directory (inspect with "
@@ -963,10 +1099,10 @@ def main() -> int:
         parser.error("--gens must be >= 1")
     if sum((args.poet, args.pixels, args.biped, args.attention,
             args.lm, args.store, args.telemetry, args.sched,
-            args.transport, args.cluster)) > 1:
+            args.transport, args.cluster, args.recovery)) > 1:
         parser.error("--poet/--pixels/--biped/--attention/--lm/--store/"
-                     "--telemetry/--sched/--transport/--cluster are "
-                     "mutually exclusive")
+                     "--telemetry/--sched/--transport/--cluster/"
+                     "--recovery are mutually exclusive")
     if args.store:
         # Host-plane only: no accelerator probe, no watchdog — the
         # store bench must run identically on a laptop and a pod host.
@@ -979,6 +1115,8 @@ def main() -> int:
         return _transport_bench(args)  # host-plane only, like --store
     if args.cluster:
         return _cluster_bench(args)  # host-plane only, like --store
+    if args.recovery:
+        return _recovery_bench(args)  # host-plane only, like --store
     if args.pop is not None and args.pop < 2:
         parser.error("--pop must be >= 2")
     if args.steps is not None and args.steps < 1:

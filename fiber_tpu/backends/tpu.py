@@ -162,6 +162,7 @@ class TpuBackend(Backend):
             self._detector = FailureDetector(
                 float(cfg.suspect_timeout), self._on_host_suspect,
                 permanent=False, name="fiber-agent-detector",
+                on_revive=self._on_host_revive,
             ).start()
             self._prober = Heartbeater(
                 self._probe_hosts, float(cfg.heartbeat_interval),
@@ -232,6 +233,43 @@ class TpuBackend(Backend):
             "health: host agent %s:%s silent past suspect_timeout; "
             "suspending placement on it (revives on next answer)",
             host[0], host[1])
+        # Host-loss tolerance (docs/robustness.md): precious digests —
+        # ledger-journaled result payloads and active broadcasts — gain
+        # a replica on a healthy host NOW, while "suspect" may still
+        # become "dead". Off the detector thread: a slow agent push must
+        # never delay further declarations.
+        try:
+            if bool(config.get().store_replicate):
+                threading.Thread(
+                    target=self._replicate_precious, args=(host,),
+                    name="fiber-store-replicate", daemon=True,
+                ).start()
+        except Exception:  # noqa: BLE001 - durability bonus only
+            logger.warning("store: replication kickoff failed",
+                           exc_info=True)
+
+    def _on_host_revive(self, host) -> None:
+        """A declared-suspect host answered again: clear its spawn
+        breaker so placement resumes immediately — an open period earned
+        while the host was down must not park a recovered host."""
+        self._host_breaker.record_success(host)
+        logger.info("health: host %s:%s revived; spawn breaker cleared",
+                    host[0], host[1])
+
+    def _replicate_precious(self, suspect) -> int:
+        from fiber_tpu import store as storemod
+        from fiber_tpu.store.replicate import REPLICATOR
+
+        targets = [h for h in self._hosts
+                   if h != suspect and self._host_healthy(h)]
+        local = storemod.local_store()
+        return REPLICATOR.replicate_for_suspect(
+            f"{suspect[0]}:{suspect[1]}", targets,
+            get_bytes=local.get_bytes,
+            host_has=lambda h, d: self._agent(h).call("store_has", d),
+            host_put=lambda h, d, data: self._agent(h).call(
+                "store_put", d, data),
+        )
 
     def host_health(self) -> Dict[str, str]:
         """Operator-facing snapshot: host -> 'ok'|'suspect'|'open'."""
@@ -534,6 +572,25 @@ class TpuBackend(Backend):
             except Exception:  # noqa: BLE001 - locality is optional
                 continue
         return out
+
+    def fetch_object(self, digest: str) -> Optional[bytes]:
+        """Pull one store object from whichever host cache still holds
+        it (agent ``store_has`` + ``store_get``), digest-verified — the
+        recovery path of ``fiber-tpu resume``: a journaled result whose
+        master-disk copy is gone is fetched from the per-host stores
+        instead of being recomputed. None when no host has it."""
+        import hashlib as _hashlib
+
+        for host in self._hosts:
+            try:
+                if not self._agent(host).call("store_has", digest):
+                    continue
+                data = bytes(self._agent(host).call("store_get", digest))
+                if _hashlib.sha256(data).hexdigest() == digest:
+                    return data
+            except Exception:  # noqa: BLE001 - try the next host
+                continue
+        return None
 
     def store_stats(self) -> Dict[str, dict]:
         """Per-host object-cache counters, the store-plane sibling of

@@ -23,6 +23,10 @@ explain        classify where a traced map's time went (straggler /
                store-fetch) from a trace artifact + flight events
 postmortem     list/print black-box bundles (dead-worker flight events
                + stack dumps), locally or pulled from host agents
+resume         resume a crashed durable map from its write-ahead ledger
+               (``Pool.map(..., job_id=...)``): restore journaled
+               results, re-execute only the remainder
+jobs           list durable-map ledgers under the staging root
 logs           fetch a job's log tail by jid (host:port/jobid)
 cp             stage files to/from hosts through the agents
 =============  ==========================================================
@@ -769,6 +773,108 @@ def cmd_postmortem(args) -> int:
     return 0
 
 
+def cmd_resume(args) -> int:
+    """Resume one durable map from its write-ahead ledger
+    (docs/robustness.md): reconstruct the call from the journaled spec
+    payload, restore every completed chunk's results by digest (master
+    disk first, then the per-host caches), and re-execute ONLY the
+    remainder — exactly one result per task, proven by the printed
+    restored/executed split. Run with the same backend environment
+    (FIBER_BACKEND / FIBER_TPU_HOSTS / FIBER_CLUSTER_KEY) as the
+    crashed master."""
+    import fiber_tpu
+    from fiber_tpu import serialization
+    from fiber_tpu import store as storemod
+    from fiber_tpu.store import ledger as ledgermod
+
+    try:
+        path = ledgermod.job_path(args.job_id, args.ledger_dir or None)
+    except ValueError as err:
+        raise SystemExit(f"error: {err}") from None
+    if not os.path.exists(path):
+        known = ledgermod.list_jobs(args.ledger_dir or None)
+        hint = f" (known jobs: {', '.join(known)})" if known else ""
+        raise SystemExit(
+            f"error: no ledger for job {args.job_id!r} at {path}{hint}")
+    try:
+        header, completed, done = ledgermod.load(path)
+    except (OSError, ValueError) as err:
+        raise SystemExit(f"error: cannot load ledger: {err}") from None
+    spec_digest = header.get("spec")
+    if not spec_digest:
+        raise SystemExit(
+            "error: this ledger carries no resumable spec payload; "
+            "resume by re-calling Pool.map(..., job_id=...) from the "
+            "original script")
+    data = storemod.local_store().get_bytes(spec_digest)
+    if data is None:
+        from fiber_tpu.backends import get_backend
+
+        fetch = getattr(get_backend(), "fetch_object", None)
+        data = fetch(spec_digest) if fetch is not None else None
+    if data is None:
+        raise SystemExit(
+            f"error: spec payload {spec_digest[:12]} not found in any "
+            "store tier; resume from the original script instead")
+    try:
+        func_blob, items, star, chunksize = serialization.loads(data)
+        func = serialization.loads(func_blob)
+    except Exception as err:  # noqa: BLE001
+        raise SystemExit(
+            f"error: spec payload did not deserialize: {err}") from None
+    print(f"resume: job {args.job_id!r} — {len(items)} tasks, "
+          f"{len(completed)} chunk(s) already journaled"
+          + (" (ledger already complete)" if done else ""),
+          file=sys.stderr)
+    with fiber_tpu.Pool(args.processes or None) as pool:
+        if star:
+            results = pool.starmap(func, items, chunksize=chunksize,
+                                   job_id=args.job_id)
+        else:
+            results = pool.map(func, items, chunksize=chunksize,
+                               job_id=args.job_id)
+        info = pool.ledger_stats()
+    summary = {
+        "job_id": args.job_id,
+        "tasks": len(results),
+        "restored_tasks": int(info.get("restored_tasks") or 0),
+        "executed_tasks": len(results) - int(
+            info.get("restored_tasks") or 0),
+        "restored_chunks": int(info.get("restored_chunks") or 0),
+        "chunks": int(info.get("chunks") or 0),
+        "trace": info.get("trace"),
+    }
+    if args.out:
+        with open(args.out, "wb") as fh:
+            fh.write(serialization.dumps(results))
+        summary["out"] = args.out
+    print(json.dumps(summary))
+    return 0
+
+
+def cmd_jobs(args) -> int:
+    """List durable-map ledgers (job id, chunk counts, done flag)."""
+    from fiber_tpu.store import ledger as ledgermod
+
+    jobs = ledgermod.list_jobs(args.ledger_dir or None)
+    if not jobs:
+        print("no job ledgers under "
+              f"{args.ledger_dir or ledgermod.default_ledger_dir()}")
+        return 0
+    for job in jobs:
+        try:
+            header, completed, done = ledgermod.load(
+                ledgermod.job_path(job, args.ledger_dir or None))
+        except (OSError, ValueError) as err:
+            print(f"{job}  unreadable ({err})", file=sys.stderr)
+            continue
+        n_items = int(header.get("n_items") or 0)
+        print(f"{job}  tasks={n_items} "
+              f"journaled_chunks={len(completed)} "
+              f"{'done' if done else 'RESUMABLE'}")
+    return 0
+
+
 def cmd_logs(args) -> int:
     """Fetch a job's log tail by its jid (``host:port/jid`` — as printed
     by ``run --submit`` and carried by ``Process.job.jid``)."""
@@ -971,6 +1077,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeout", type=float, default=20.0,
                    help="seconds to wait for the jax device probe")
     p.set_defaults(fn=cmd_doctor)
+
+    p = sub.add_parser(
+        "resume", help="resume a crashed durable map from its "
+                       "write-ahead ledger (Pool.map job_id=)")
+    p.add_argument("job_id", help="the job_id passed to Pool.map")
+    p.add_argument("--ledger-dir", default="",
+                   help="ledger directory (default: config ledger_dir "
+                        "or <staging root>/ledger)")
+    p.add_argument("--processes", type=int, default=0,
+                   help="pool size for the resumed run (default: "
+                        "backend default)")
+    p.add_argument("--out", default="",
+                   help="write the full result list (pickled) here")
+    p.set_defaults(fn=cmd_resume)
+
+    p = sub.add_parser("jobs",
+                       help="list durable-map ledgers and their state")
+    p.add_argument("--ledger-dir", default="")
+    p.set_defaults(fn=cmd_jobs)
 
     p = sub.add_parser("logs", help="fetch a job's log tail by jid")
     p.add_argument("jid", help="host:port/jobid (as printed by --submit)")

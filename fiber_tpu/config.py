@@ -111,6 +111,25 @@ DEFAULTS: Dict[str, Any] = {
     # where the staging root is FIBER_AGENT_STAGING or
     # ~/.fiber_tpu/staging (utils/staging.py / host_agent.py).
     "store_dir": "",
+    # --- durability (docs/robustness.md "Durable maps") ---
+    # Write-ahead map ledger: Pool.map(..., job_id=...) journals the
+    # task spec + every completed chunk's result digest under
+    # ledger_dir, making the map resumable across master crashes
+    # (`fiber-tpu resume <job_id>` / re-calling map with the job_id).
+    # Off, job_id is accepted but nothing is journaled.
+    "ledger_enabled": True,
+    # Ledger directory. "" = <staging root>/ledger, beside the objects/
+    # cache the journaled result payloads persist into.
+    "ledger_dir": "",
+    # Accumulation window of the ledger writer thread, seconds: chunk
+    # records queued within it land in one write + one fsync. The hot
+    # result loop only ever pays a buffered append.
+    "ledger_fsync_s": 0.05,
+    # Re-replicate precious digests (ledger-journaled results, active
+    # broadcasts) to a second healthy host when the health plane
+    # declares their holder suspect — recovery then never needs the
+    # dead host.
+    "store_replicate": True,
     # Strip accelerator runtime preloads from spawned host workers (faster
     # interpreter boot; only for workers that never touch the device).
     "worker_lite": False,

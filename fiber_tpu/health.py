@@ -126,11 +126,14 @@ class FailureDetector:
     def __init__(self, suspect_timeout: float,
                  on_suspect: Callable[[object], None],
                  permanent: bool = True,
-                 name: str = "fiber-failure-detector") -> None:
+                 name: str = "fiber-failure-detector",
+                 on_revive: Optional[Callable[[object], None]] = None
+                 ) -> None:
         if suspect_timeout <= 0:
             raise ValueError("suspect_timeout must be > 0")
         self._timeout = float(suspect_timeout)
         self._on_suspect = on_suspect
+        self._on_revive = on_revive
         self._permanent = permanent
         self._last_seen: Dict[object, float] = {}
         self._dead: set = set()
@@ -163,6 +166,16 @@ class FailureDetector:
             FLIGHT.record("health", "revive", peer=_peer_label(peer))
             logger.info("health: peer %r revived after being declared "
                         "dead", peer)
+            if self._on_revive is not None:
+                # Outside the lock (handlers may call back in). The
+                # backend uses this to clear the peer's stale circuit
+                # breaker: a host that answers again must not stay
+                # parked behind an open period earned while it was down.
+                try:
+                    self._on_revive(peer)
+                except Exception:
+                    logger.exception("health: on_revive handler failed "
+                                     "for %r", peer)
 
     def forget(self, peer) -> None:
         """Deregister a peer whose death was observed through another

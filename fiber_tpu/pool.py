@@ -1120,6 +1120,14 @@ class Pool:
         self._seq_ctx: Dict[int, Tuple] = {}
         self._seq_ctx_lock = threading.Lock()
         self._store_fallbacks = 0
+        #: Durable-map ledger plane (docs/robustness.md): seq -> open
+        #: MapLedger for maps submitted with job_id=. The result loop
+        #: journals each completed chunk through it; resume restores
+        #: journaled chunks without re-execution.
+        self._ledgers: Dict[int, Any] = {}
+        self._ledger_local = None   # fallback LocalStore when _objstore off
+        self._ledger_last: Dict[str, Any] = {}
+        self._n_restored = 0
 
         self._store = ResultStore()
         # Scheduler plane (fiber_tpu/sched, docs/scheduling.md): the
@@ -1476,6 +1484,11 @@ class Pool:
                 self._n_completed += len(values)
                 _m_tasks_completed.inc(len(values))
                 self._on_result(seq, base, values, ident)
+                if self._ledgers:
+                    # Durable maps: one buffered append on this hot
+                    # loop; the ledger's writer thread owns the
+                    # serialize + disk persist + fsync.
+                    self._journal_chunk(seq, base, values)
                 self._store.fill(seq, base, values)
                 _g_inflight.set(self._store.outstanding())
             except Exception:
@@ -1533,10 +1546,17 @@ class Pool:
         failure or abort — completion callbacks fire on all three)."""
         with self._seq_ctx_lock:
             self._seq_ctx[seq] = (digest, blob, star, items, tctx)
+        # The active broadcast is precious while the map is in flight:
+        # the replication hook copies it off a suspect host so recovery
+        # (and late locality fetches) never need the dead one.
+        from fiber_tpu.store.replicate import REPLICATOR
+
+        REPLICATOR.note(seq_digests)
 
         def _cleanup() -> None:
             with self._seq_ctx_lock:
                 self._seq_ctx.pop(seq, None)
+            REPLICATOR.forget(seq_digests)
             for d in seq_digests:
                 self._objstore.release(d)
 
@@ -1616,6 +1636,197 @@ class Pool:
                 self._objstore.release(v.digest)
         return out
 
+    # -- durable maps (fiber_tpu/store/ledger, docs/robustness.md) ---------
+    def _ledger_store(self):
+        """Store the journaled result payloads persist into: the pool's
+        own object store when the by-reference plane is up, else the
+        process LocalStore (its disk tier works regardless — durability
+        must not depend on the wire plane being enabled)."""
+        if self._objstore is not None:
+            return self._objstore
+        if self._ledger_local is None:
+            from fiber_tpu import store as storemod
+
+            self._ledger_local = storemod.local_store()
+        return self._ledger_local
+
+    def _ledger_open(self, job_id: str, func: Callable, items: List[Any],
+                     chunksize: int, star: bool,
+                     trace_id: Optional[str]):
+        """Open (or resume) the job's write-ahead ledger. Returns
+        ``(ledger|None, completed, chunksize, trace_id)`` — on resume
+        the recorded chunking and trace id override the caller's, so
+        chunk spans line up with the journal and resubmitted chunks
+        keep their trace (envelope-reuse rule)."""
+        from fiber_tpu import config as _config
+        from fiber_tpu.store import ledger as ledgermod
+        from fiber_tpu.store.replicate import REPLICATOR
+
+        cfg = _config.get()
+        if not bool(cfg.ledger_enabled):
+            return None, {}, chunksize, trace_id
+        path = ledgermod.job_path(job_id)
+        tdigest = ledgermod.task_digest(func, len(items), star)
+        store = self._ledger_store()
+        fsync_s = float(cfg.ledger_fsync_s)
+
+        def note_chunk(digest: str) -> None:
+            # Journaled results are PRECIOUS: the replication hook
+            # copies them off a suspect host (docs/robustness.md).
+            REPLICATOR.note((digest,))
+
+        if os.path.exists(path):
+            try:
+                header, completed, _done = ledgermod.load(path)
+            except ValueError:
+                # A crash between file creation and the header fsync
+                # leaves a headerless file: nothing was dispatched under
+                # it, so the job simply starts fresh (appending — load
+                # skips any torn garbage before the new header).
+                logger.warning("ledger: %s has no readable header; "
+                               "starting job %r fresh", path, job_id)
+                header = None
+            if header is not None:
+                if header.get("task_digest") != tdigest:
+                    raise ValueError(
+                        f"job_id {job_id!r} was journaled by a "
+                        "different task spec (function / item count / "
+                        "call shape changed); pick a new job_id or "
+                        f"delete {path}")
+                chunksize = int(header.get("chunksize") or chunksize)
+                led = ledgermod.MapLedger(path, store,
+                                          fsync_interval=fsync_s,
+                                          on_chunk=note_chunk)
+                led.adopt(completed)
+                REPLICATOR.note(d for _, d in completed.values())
+                if header.get("trace") and trace_id is not None:
+                    trace_id = str(header["trace"])
+                FLIGHT.record("store", "ledger", job=job_id,
+                              event="resume", completed=len(completed))
+                return led, completed, chunksize, trace_id
+        led = ledgermod.MapLedger(path, store, fsync_interval=fsync_s,
+                                  on_chunk=note_chunk)
+        spec_digest = None
+        try:
+            # Resumable spec payload: `fiber-tpu resume <job_id>` runs
+            # from a dead master's ledger alone, so the call itself must
+            # be reconstructible. The function is cloudpickled BY VALUE:
+            # a plain pickle of a `__main__`-defined function is a
+            # by-reference pointer only the dead master's re-imported
+            # main module could resolve — the resume CLI is a different
+            # __main__. Persisted to the disk tier like the chunk
+            # payloads; an unpicklable spec only loses the CLI path
+            # (re-calling map with the job_id still resumes).
+            try:
+                import cloudpickle as _cp
+
+                func_blob = _cp.dumps(func)
+            except Exception:  # noqa: BLE001 - no cloudpickle / exotic fn
+                func_blob = serialization.dumps(func)
+            spec_data = serialization.dumps(
+                (func_blob, list(items), bool(star), int(chunksize)))
+            spec_digest = store.put_bytes(
+                spec_data, refs=1, persist=True).digest
+        except Exception:  # noqa: BLE001
+            logger.warning(
+                "ledger: spec payload for job %r not serializable; "
+                "`fiber-tpu resume` needs the original call site",
+                job_id, exc_info=True)
+        led.write_header({
+            "job_id": job_id, "task_digest": tdigest,
+            "spec": spec_digest, "n_items": len(items),
+            "chunksize": int(chunksize), "star": bool(star),
+            "trace": trace_id,
+        })
+        return led, {}, chunksize, trace_id
+
+    def _ledger_restore_all(self, job_id,
+                            completed) -> Dict[int, List[Any]]:
+        """Fetch every journaled chunk's result values; a payload lost
+        from every tier just re-executes its chunk (lineage posture:
+        recompute only what was lost)."""
+        out: Dict[int, List[Any]] = {}
+        for base, (n, digest) in completed.items():
+            values = self._ledger_restore(digest, n)
+            if values is None:
+                logger.warning(
+                    "ledger: job %r chunk base=%d payload %s lost from "
+                    "every store tier; re-executing that chunk",
+                    job_id, base, digest[:12])
+                FLIGHT.record("store", "ledger", job=job_id,
+                              event="lost", base=base, digest=digest[:8])
+                continue
+            out[base] = values
+        return out
+
+    def _ledger_restore(self, digest: str,
+                        n: int) -> Optional[List[Any]]:
+        store = self._ledger_store()
+        data = store.get_bytes(digest)
+        if data is None:
+            # Master disk lost the payload (new machine, wiped staging):
+            # the per-host caches are the second line — exactly what the
+            # suspect-time replication hook keeps populated.
+            from fiber_tpu.backends import get_backend
+
+            fetch = getattr(get_backend(), "fetch_object", None)
+            if fetch is not None:
+                try:
+                    data = fetch(digest)
+                except Exception:  # noqa: BLE001
+                    data = None
+            if data is not None:
+                try:  # republish so the next resume reads local disk
+                    store.put_bytes(data, persist=True, digest=digest)
+                except Exception:  # noqa: BLE001
+                    pass
+        if data is None:
+            return None
+        try:
+            values = serialization.loads(data)
+        except Exception:  # noqa: BLE001 - corrupt payload == lost
+            return None
+        if not isinstance(values, list) or len(values) != n:
+            return None
+        return values
+
+    def _journal_chunk(self, seq: int, base: int,
+                       values: List[Any]) -> None:
+        led = self._ledgers.get(seq)
+        if led is None or led.has(base):
+            return
+        if any(isinstance(v, _Failure) for v in values):
+            # Failed slots are not completions: the chunk re-executes on
+            # resume (idempotent tasks; a deterministic failure simply
+            # fails again, visibly).
+            return
+        led.record_chunk(base, len(values), values)
+
+    def _ledger_done(self, seq: int) -> None:
+        """Map completion: close the journal with a ``done`` record and
+        release the job's precious-digest registrations."""
+        led = self._ledgers.pop(seq, None)
+        if led is None:
+            return
+        from fiber_tpu.store.replicate import REPLICATOR
+
+        led.record_done()
+        led.close()
+        REPLICATOR.forget(led.digests)
+
+    def ledger_stats(self) -> Dict[str, Any]:
+        """Durability counters: the last job_id map's restore/pending
+        split (the exactly-once proof surface — restored + executed ==
+        total), lifetime restored-task count, and the replication
+        registry snapshot."""
+        from fiber_tpu.store.replicate import REPLICATOR
+
+        out = dict(self._ledger_last)
+        out["tasks_restored_total"] = self._n_restored
+        out["active_ledgers"] = len(self._ledgers)
+        out["replication"] = REPLICATOR.snapshot()
+        return out
+
     def put_object(self, obj: Any) -> ObjectRef:
         """Explicitly stage one object in the pool's store and get the
         ref back: pass it (alone, or inside arg tuples) to any map/apply
@@ -1655,6 +1866,7 @@ class Pool:
                        if name.startswith("pool.")},
             "tasks_submitted": self._n_submitted,
             "tasks_completed": self._n_completed,
+            "tasks_restored": self._n_restored,
             "chunks_resubmitted": self._n_resubmitted,
             "store_fallbacks": self._store_fallbacks,
             "queue_depth": self._taskq.qsize(),
@@ -1704,6 +1916,7 @@ class Pool:
         error_callback: Optional[Callable] = None,
         single: bool = False,
         priority: float = 1.0,
+        job_id: Optional[str] = None,
     ) -> AsyncResult:
         if self._closed or self._terminated:
             raise ValueError("Pool not running")
@@ -1715,12 +1928,6 @@ class Pool:
                                   callback, error_callback)
         if not items:
             return result
-        # Scheduler registration before any chunk is queued: priority is
-        # the WDRR weight across concurrently active maps; the map's
-        # state (queued duplicates included) is dropped at completion.
-        self._sched.register_map(seq, priority)
-        self._store.add_callback(
-            seq, lambda: self._sched.release_map(seq))
         if chunksize is None:
             # Ceil division (multiprocessing's formula): floor leaves a
             # remainder chunk that lands as one worker's straggler tail —
@@ -1730,52 +1937,120 @@ class Pool:
             # (reference fixed chunk: fiber/pool.py:1169-1170).
             chunksize = max(1, min(DEFAULT_CHUNKSIZE,
                                    -(-len(items) // (self._n_workers * 4))))
-        self._n_submitted += len(items)
-        _m_tasks_submitted.inc(len(items))
         # One trace per sampled map: its id + the serialize span's id
         # ride every task envelope so worker spans join the same trace
         # (docs/observability.md). Unsampled maps ship tctx=None and the
-        # workers record nothing.
+        # workers record nothing. Sampled BEFORE the ledger opens: the
+        # header records the id, and a resumed map adopts the recorded
+        # one — resubmitted-after-crash chunks keep their trace (the
+        # envelope-reuse rule, same as storemiss/death resubmission).
         trace_id = telemetry.maybe_start_trace()
+        # Durable-map ledger (docs/robustness.md): with job_id= the map
+        # is journaled write-ahead and resumable across master crashes.
+        # A pre-existing ledger for this job_id means THIS call is the
+        # resume: restore its journaled chunks, run only the remainder.
+        ledger = None
+        completed: Dict[int, Tuple[int, str]] = {}
+        if job_id is not None:
+            try:
+                ledger, completed, chunksize, trace_id = \
+                    self._ledger_open(job_id, func, items, chunksize,
+                                      star, trace_id)
+            except ValueError:
+                self._store.fail(seq, RuntimeError("ledger rejected"),
+                                 reason="ledger spec mismatch")
+                raise
+            except Exception:  # noqa: BLE001 - durability best-effort
+                logger.warning(
+                    "ledger: journaling disabled for job %r (open "
+                    "failed); the map runs but is not resumable",
+                    job_id, exc_info=True)
+                ledger, completed = None, {}
+        restorable: Dict[int, List[Any]] = {}
+        if completed:
+            restorable = self._ledger_restore_all(job_id, completed)
+        # Scheduler registration before any chunk is queued: priority is
+        # the WDRR weight across concurrently active maps; the map's
+        # state (queued duplicates included) is dropped at completion.
+        self._sched.register_map(seq, priority)
+        self._store.add_callback(
+            seq, lambda: self._sched.release_map(seq))
+        if ledger is not None:
+            self._ledgers[seq] = ledger
+            self._store.add_callback(seq,
+                                     lambda: self._ledger_done(seq))
+        self._n_submitted += len(items)
+        _m_tasks_submitted.inc(len(items))
+        spans = _chunk_spans(len(items), chunksize)
+        pending = [s for s in spans if s[0] not in restorable]
+        if ledger is not None:
+            self._ledger_last = {
+                "job_id": job_id, "seq": seq, "trace": trace_id,
+                "chunks": len(spans),
+                "restored_chunks": len(restorable),
+                "pending_chunks": len(pending),
+                "restored_tasks": sum(len(v)
+                                      for v in restorable.values()),
+            }
         FLIGHT.record("pool", "submit", seq=seq, items=len(items),
-                      trace=trace_id)
+                      trace=trace_id, job=job_id,
+                      restored_chunks=len(restorable) or None)
         root_span = (tracing.span("pool.serialize", trace=trace_id,
                                   seq=seq, items=len(items))
-                     if trace_id else contextlib.nullcontext())
-        with global_timer.section("pool.serialize"), root_span as sp:
-            tctx = (trace_id, sp["span"]) if sp is not None else None
-            blob = serialization.dumps(func)
-            digest = hashlib.md5(blob).digest()
-            enc_items = items
-            if self._objstore is not None and self._store_inline_max:
-                seq_digests: List[str] = []
-                try:
-                    with global_timer.section("pool.store_encode"):
-                        enc_items = self._encode_items(items, seq_digests)
-                except Exception:  # noqa: BLE001 - optimization only
-                    logger.warning(
-                        "store: arg encoding failed; shipping inline",
-                        exc_info=True)
-                    enc_items = items
-                    seq_digests = []
-                if seq_digests:
-                    self._arm_store_fallback(seq, digest, blob, star,
-                                             items, seq_digests, tctx)
-                    # Locality seed: this host's store owns the refs,
-                    # and the backend may know other hosts that already
-                    # cache them (prestaged via put_object).
-                    self._sched.note_host_has(local_host_key(),
-                                              seq_digests)
-                    self._probe_ref_locations(seq_digests)
-            for base, size in _chunk_spans(len(enc_items), chunksize):
-                chunk = enc_items[base:base + size]
-                digs = _chunk_digests(chunk)
-                if digs:
-                    self._sched.register_chunk((seq, base), digs)
-                payload = serialization.dumps(
-                    ("task", seq, base, digest, blob, chunk, star, tctx)
-                )
-                self._taskq.put((payload, (seq, base)))
+                     if trace_id and pending else contextlib.nullcontext())
+        if pending:
+            with global_timer.section("pool.serialize"), root_span as sp:
+                tctx = (trace_id, sp["span"]) if sp is not None else None
+                blob = serialization.dumps(func)
+                digest = hashlib.md5(blob).digest()
+                enc_items = items
+                if self._objstore is not None and self._store_inline_max:
+                    seq_digests: List[str] = []
+                    try:
+                        with global_timer.section("pool.store_encode"):
+                            enc_items = self._encode_items(items,
+                                                           seq_digests)
+                    except Exception:  # noqa: BLE001 - optimization only
+                        logger.warning(
+                            "store: arg encoding failed; shipping inline",
+                            exc_info=True)
+                        enc_items = items
+                        seq_digests = []
+                    if seq_digests:
+                        self._arm_store_fallback(seq, digest, blob, star,
+                                                 items, seq_digests, tctx)
+                        # Locality seed: this host's store owns the refs,
+                        # and the backend may know other hosts that
+                        # already cache them (prestaged via put_object).
+                        self._sched.note_host_has(local_host_key(),
+                                                  seq_digests)
+                        self._probe_ref_locations(seq_digests)
+                for base, size in pending:
+                    chunk = enc_items[base:base + size]
+                    digs = _chunk_digests(chunk)
+                    if digs:
+                        self._sched.register_chunk((seq, base), digs)
+                    payload = serialization.dumps(
+                        ("task", seq, base, digest, blob, chunk, star,
+                         tctx)
+                    )
+                    self._taskq.put((payload, (seq, base)))
+        if restorable:
+            # Journaled chunks fill directly — never re-executed, never
+            # re-dispatched; exactly one result per task is the ledger's
+            # contract. Fills run after the remainder is queued so a
+            # fully-restored map completes (and fires its callbacks)
+            # only once everything is registered.
+            n_restored = 0
+            for base, values in restorable.items():
+                self._store.fill(seq, base, values)
+                n_restored += len(values)
+            self._n_restored += n_restored
+            logger.warning(
+                "ledger: job %r resumed — restored %d/%d chunks "
+                "(%d tasks) from the journal; executing %d chunks",
+                job_id, len(restorable), len(spans), n_restored,
+                len(pending))
         _g_queue_depth.set(self._taskq.qsize())
         if self._resilient and getattr(self, "_parked_count", 0):
             # New chunks can clear parked requests' reservation gates.
@@ -1839,7 +2114,8 @@ class Pool:
         return device_map(func, items, star=star)
 
     def _dispatch_async(self, func, items, star, chunksize,
-                        callback, error_callback, priority=1.0):
+                        callback, error_callback, priority=1.0,
+                        job_id=None):
         """Device-or-host submission shared by every map variant, with
         async error contracts preserved on the device path (user-function
         errors reach error_callback / .get(); only pool-state errors
@@ -1855,7 +2131,12 @@ class Pool:
         if not self._wants_device(func):
             return self._submit(func, items, chunksize, star,
                                 callback, error_callback,
-                                priority=priority)
+                                priority=priority, job_id=job_id)
+        if job_id is not None:
+            # Device dispatch is one mesh call, not a chunk stream —
+            # there is nothing partial to journal or resume.
+            logger.warning("ledger: job_id %r ignored for "
+                           "@meta(device=True) dispatch", job_id)
         store = ResultStore()
         seq = store.add(len(items))
         result = AsyncResult(store, seq, single=False)
@@ -1888,9 +2169,17 @@ class Pool:
         iterable: Iterable[Any],
         chunksize: Optional[int] = None,
         priority: float = 1.0,
+        job_id: Optional[str] = None,
     ) -> List[Any]:
+        """``job_id=`` makes the map durable (docs/robustness.md): the
+        task spec and every completed chunk are journaled write-ahead
+        under ``<staging>/ledger/<job_id>``, and a master crash is
+        survivable — ``fiber-tpu resume <job_id>`` (or re-calling map
+        with the same job_id) restores completed results and re-executes
+        only the remainder. Tasks must be idempotent (the resilient-pool
+        contract already requires this)."""
         return self.map_async(func, iterable, chunksize,
-                              priority=priority).get()
+                              priority=priority, job_id=job_id).get()
 
     def map_async(
         self,
@@ -1900,9 +2189,11 @@ class Pool:
         callback: Optional[Callable] = None,
         error_callback: Optional[Callable] = None,
         priority: float = 1.0,
+        job_id: Optional[str] = None,
     ):
         return self._dispatch_async(func, list(iterable), False, chunksize,
-                                    callback, error_callback, priority)
+                                    callback, error_callback, priority,
+                                    job_id=job_id)
 
     def starmap(
         self,
@@ -1910,9 +2201,10 @@ class Pool:
         iterable: Iterable[Tuple],
         chunksize: Optional[int] = None,
         priority: float = 1.0,
+        job_id: Optional[str] = None,
     ) -> List[Any]:
         return self.starmap_async(func, iterable, chunksize,
-                                  priority=priority).get()
+                                  priority=priority, job_id=job_id).get()
 
     def starmap_async(
         self,
@@ -1922,10 +2214,12 @@ class Pool:
         callback: Optional[Callable] = None,
         error_callback: Optional[Callable] = None,
         priority: float = 1.0,
+        job_id: Optional[str] = None,
     ):
         return self._dispatch_async(func, [tuple(t) for t in iterable],
                                     True, chunksize, callback,
-                                    error_callback, priority)
+                                    error_callback, priority,
+                                    job_id=job_id)
 
     def imap(
         self,
@@ -1933,13 +2227,14 @@ class Pool:
         iterable: Iterable[Any],
         chunksize: Optional[int] = None,
         priority: float = 1.0,
+        job_id: Optional[str] = None,
     ):
         items = list(iterable)
         device_out = self._device_dispatch(func, items, star=False)
         if device_out is not None:
             return iter(device_out)
         res = self._submit(func, items, chunksize, False,
-                           priority=priority)
+                           priority=priority, job_id=job_id)
         return _ResultIterator(self._store.iter_ordered(res._seq))
 
     def imap_unordered(
@@ -1948,13 +2243,14 @@ class Pool:
         iterable: Iterable[Any],
         chunksize: Optional[int] = None,
         priority: float = 1.0,
+        job_id: Optional[str] = None,
     ):
         items = list(iterable)
         device_out = self._device_dispatch(func, items, star=False)
         if device_out is not None:
             return iter(device_out)
         res = self._submit(func, items, chunksize, False,
-                           priority=priority)
+                           priority=priority, job_id=job_id)
         return _ResultIterator(self._store.iter_unordered(res._seq))
 
     # -- lifecycle ---------------------------------------------------------
@@ -2033,6 +2329,15 @@ class Pool:
         self._sched.close()
         self._task_ep.close()
         self._result_ep.close()
+        # Incomplete job ledgers stay on disk (that IS the durability
+        # contract — `fiber-tpu resume` picks them up); only the writer
+        # threads are stopped, after a final drain.
+        for led in list(self._ledgers.values()):
+            try:
+                led.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._ledgers.clear()
 
     def __enter__(self) -> "Pool":
         return self

@@ -29,6 +29,7 @@ from fiber_tpu.store.plane import (  # noqa: F401
     StoreFetchError,
     StoreServer,
 )
+from fiber_tpu.store.replicate import REPLICATOR  # noqa: F401
 
 _lock = threading.Lock()
 _store: Optional[LocalStore] = None

@@ -39,6 +39,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from fiber_tpu import serialization
 from fiber_tpu.telemetry.flightrec import FLIGHT
+from fiber_tpu.testing import chaos
 from fiber_tpu.utils.logging import get_logger
 
 logger = get_logger()
@@ -126,6 +127,7 @@ class LocalStore:
             "puts": 0, "put_dedup_hits": 0,
             "ram_hits": 0, "disk_hits": 0, "misses": 0,
             "evictions": 0, "spills": 0, "spill_bytes": 0,
+            "disk_corrupt": 0,
         }
 
     # -- paths ----------------------------------------------------------
@@ -297,6 +299,12 @@ class LocalStore:
         path = self._path(digest)
         if os.path.exists(path):
             return True
+        plan = chaos._plan
+        if plan is not None:
+            # Chaos corrupt_store_disk: the bytes that hit disk differ
+            # from the digest — _read_disk's verification is the
+            # degradation under test (docs/robustness.md).
+            data = plan.corrupt_disk_write(data)
         try:
             os.makedirs(self.root, exist_ok=True)
             fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
@@ -323,9 +331,31 @@ class LocalStore:
             return None
         try:
             with open(self._path(digest), "rb") as fh:
-                return fh.read()
+                data = fh.read()
         except OSError:
             return None
+        # The file IS the content address: verify it on every disk read
+        # (spill reload, cross-process host-cache hit) exactly like the
+        # wire fetch path does — silent disk corruption must degrade to
+        # a miss (and a refetch from the owner), never a wrong payload.
+        # The corrupt file is quarantined so the refetch can republish.
+        if digest_of(data) != digest:
+            with self._lock:
+                self._stats["disk_corrupt"] += 1
+            FLIGHT.record("store", "disk_corrupt", digest=digest[:8],
+                          bytes=len(data),
+                          reason="cache/spill file failed digest "
+                                 "verification; treating as miss")
+            logger.warning(
+                "object store: disk file for %s failed digest "
+                "verification (%d bytes); removed — callers refetch",
+                digest[:12], len(data))
+            try:
+                os.unlink(self._path(digest))
+            except OSError:
+                pass
+            return None
+        return data
 
     def _trim_disk(self) -> None:
         """Keep the disk tier under max_disk_bytes, oldest-mtime first

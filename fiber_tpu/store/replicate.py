@@ -1,0 +1,125 @@
+"""Precious-digest replication: host-loss tolerance for the store plane.
+
+Some store objects are **precious**: losing their last replica loses
+work that cannot be cheaply recomputed — the map ledger's journaled
+result payloads (docs/robustness.md "Durable maps") and the active
+broadcast objects of in-flight maps. This module is the registry of
+those digests plus the copy routine the health plane triggers: when the
+backend's failure detector declares a host suspect, the master
+re-replicates every precious digest to a second healthy host (agent
+``store_put`` into its ``<staging>/objects`` cache), so a recovery —
+even one that outlives the suspect host — never needs it.
+
+Deliberately one-way and best-effort: replication is a durability
+*bonus* on top of the master's own disk tier, never a correctness
+dependency, and it must never take the health plane down with it
+(``TpuBackend._on_host_suspect`` runs it on a throwaway thread).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List
+
+from fiber_tpu.telemetry.flightrec import FLIGHT
+from fiber_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+#: Upper bound on digests copied per suspect declaration — a suspect
+#: storm must not turn the master into a full-store mirror job.
+MAX_PER_EVENT = 128
+
+
+class Replicator:
+    """Refcounted registry of precious digests + the fan-out copier."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._refs: Dict[str, int] = {}
+        self.replicated_total = 0
+        self.failed_total = 0
+
+    # -- registry --------------------------------------------------------
+    def note(self, digests: Iterable[str]) -> None:
+        with self._lock:
+            for d in digests:
+                self._refs[d] = self._refs.get(d, 0) + 1
+
+    def forget(self, digests: Iterable[str]) -> None:
+        with self._lock:
+            for d in digests:
+                n = self._refs.get(d, 0) - 1
+                if n <= 0:
+                    self._refs.pop(d, None)
+                else:
+                    self._refs[d] = n
+
+    def precious(self) -> List[str]:
+        with self._lock:
+            return list(self._refs)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {"precious": len(self._refs),
+                    "replicated": self.replicated_total,
+                    "failed": self.failed_total}
+
+    # -- copy routine ----------------------------------------------------
+    def replicate_for_suspect(self, suspect_key: str, targets,
+                              get_bytes, host_has, host_put) -> int:
+        """Copy every precious digest to the first healthy target that
+        lacks it. Pure function over injected callables so backends and
+        tests drive it identically:
+
+        * ``targets`` — ordered healthy host keys (suspect excluded);
+        * ``get_bytes(digest)`` — local payload source (the master's
+          store: RAM or disk tier), None when unavailable;
+        * ``host_has(host, digest)`` / ``host_put(host, digest, data)``
+          — the agent cache probes/writes.
+
+        Returns how many digests gained a replica."""
+        digests = self.precious()[:MAX_PER_EVENT]
+        if not digests or not targets:
+            return 0
+        copied = 0
+        for digest in digests:
+            placed = False
+            try:
+                data = get_bytes(digest)
+            except Exception:  # noqa: BLE001 - local read must not wedge
+                data = None
+            if data is None:
+                continue
+            for host in targets:
+                try:
+                    if host_has(host, digest):
+                        placed = True  # a live replica already exists
+                        break
+                    host_put(host, digest, bytes(data))
+                    placed = True
+                    copied += 1
+                    FLIGHT.record(
+                        "store", "replicate", digest=digest[:8],
+                        host=str(host), suspect=str(suspect_key),
+                        bytes=len(data),
+                        reason="owner suspect; precious digest copied "
+                               "to a second host")
+                    break
+                except Exception:  # noqa: BLE001 - try the next host
+                    continue
+            if not placed:
+                with self._lock:
+                    self.failed_total += 1
+        with self._lock:
+            self.replicated_total += copied
+        if copied:
+            logger.warning(
+                "store: replicated %d precious object(s) away from "
+                "suspect host %s", copied, suspect_key)
+        return copied
+
+
+#: Process-wide registry: the ledger and pool register through this, the
+#: backend's suspect handler drains it.
+REPLICATOR = Replicator()

@@ -32,6 +32,26 @@ Injection points (all no-ops unless the matching knob is set):
 kill_after_chunks     pool worker ``os._exit``\\ s after completing its
                       N-th chunk (budget ``kill_times``) — induced
                       worker death mid-map
+kill_master_after_chunks  the MASTER process SIGKILLs itself once its
+                      map ledger has journaled N chunks (budget
+                      ``kill_master_times``) — master crash mid-map;
+                      the journaled records are fsync'd first, so
+                      ``fiber-tpu resume`` recovery is what's under
+                      test (docs/robustness.md)
+partition_after       a bound-``r`` ingress channel is PARTITIONED from
+                      its peer after its N-th data frame: every frame
+                      (results, heartbeats, spans) is severed for
+                      ``partition_s`` seconds, then flow resumes
+                      (budget ``partition_times``) — a network
+                      partition between a host pair; the peer is
+                      suspect, NOT dead, and its late duplicates must
+                      dedupe after the heal. Both I/O engines share the
+                      schedule via ``recv_frame_actions``.
+corrupt_store_disk    the object store's next N disk writes (spill /
+                      host-cache publication) write CORRUPTED bytes —
+                      models silent disk corruption; the digest check
+                      in ``LocalStore._read_disk`` must degrade it to
+                      a refetch, never a wrong payload
 hang_after_chunks     pool worker freezes (compute stalls AND heartbeats
                       stop) for ``hang_s`` seconds when about to run its
                       N-th chunk (budget ``hang_times``) — a hung host
@@ -83,15 +103,18 @@ FAIL_SITES = ("local_spawn", "launch", "agent_spawn", "store_fetch")
 
 _INT_FIELDS = (
     "seed", "kill_after_chunks", "kill_times",
+    "kill_master_after_chunks", "kill_master_times",
     "hang_after_chunks", "hang_times",
     "slow_worker_after_chunks", "slow_worker_times",
     "fail_local_spawn", "fail_launch", "fail_agent_spawn",
     "fail_store_fetch", "slow_store_every",
     "stall_recv_after", "stall_recv_times",
     "drop_recv_every", "send_delay_every",
+    "partition_after", "partition_times",
+    "corrupt_store_disk",
 )
 _FLOAT_FIELDS = ("hang_s", "slow_worker_s", "stall_recv_s",
-                 "send_delay_s", "slow_store_s")
+                 "send_delay_s", "slow_store_s", "partition_s")
 
 
 class ChaosError(RuntimeError):
@@ -105,6 +128,8 @@ class ChaosPlan:
 
     def __init__(self, seed: int = 0, token_dir: Optional[str] = None,
                  kill_after_chunks: int = 0, kill_times: int = 1,
+                 kill_master_after_chunks: int = 0,
+                 kill_master_times: int = 1,
                  hang_after_chunks: int = 0, hang_s: float = 3.0,
                  hang_times: int = 1,
                  slow_worker_after_chunks: int = 0,
@@ -118,12 +143,21 @@ class ChaosPlan:
                  stall_recv_times: int = 1,
                  drop_recv_every: int = 0,
                  send_delay_every: int = 0,
-                 send_delay_s: float = 0.0) -> None:
+                 send_delay_s: float = 0.0,
+                 partition_after: int = 0, partition_s: float = 0.0,
+                 partition_times: int = 1,
+                 corrupt_store_disk: int = 0) -> None:
         self.seed = int(seed)
         self.token_dir = token_dir or os.path.join(
             tempfile.gettempdir(), f"fiber-chaos-{self.seed}")
         self.kill_after_chunks = int(kill_after_chunks)
         self.kill_times = int(kill_times)
+        self.kill_master_after_chunks = int(kill_master_after_chunks)
+        self.kill_master_times = int(kill_master_times)
+        self.partition_after = int(partition_after)
+        self.partition_s = float(partition_s)
+        self.partition_times = int(partition_times)
+        self.corrupt_store_disk = int(corrupt_store_disk)
         self.hang_after_chunks = int(hang_after_chunks)
         self.hang_s = float(hang_s)
         self.hang_times = int(hang_times)
@@ -233,6 +267,33 @@ class ChaosPlan:
                 pass
             os._exit(CHAOS_EXIT_CODE)
 
+    def maybe_kill_master(self, journaled_chunks: int) -> None:
+        """Map-ledger writer, after a durable batch: SIGKILL the MASTER
+        once N chunks are journaled — no signal handlers, no atexit, the
+        hardest crash the OS can deliver. Fires at ``>= N`` (the batched
+        fsync may jump past an exact count) under a cluster-wide token
+        budget, so exactly ``kill_master_times`` masters ever die."""
+        if (self.kill_master_after_chunks
+                and journaled_chunks >= self.kill_master_after_chunks
+                and self.acquire("kill-master", self.kill_master_times)):
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def corrupt_disk_write(self, data: bytes) -> bytes:
+        """Object-store disk publication (spill / host cache): while the
+        budget lasts, the bytes that hit disk are corrupted (first and
+        last bytes flipped) — the read-side digest check is what's under
+        test."""
+        if (self.corrupt_store_disk
+                and self.acquire("corrupt-disk", self.corrupt_store_disk)):
+            bad = bytearray(data)
+            if bad:
+                bad[0] ^= 0xFF
+                bad[-1] ^= 0xFF
+            return bytes(bad)
+        return data
+
     def maybe_hang_worker(self, completed_chunks: int) -> None:
         """pool worker, before running a chunk: freeze compute AND
         heartbeats — a hung host, as seen from the master."""
@@ -288,6 +349,28 @@ class ChaosPlan:
             stall_s = self.stall_recv_s
         drop = bool(self.drop_recv_every
                     and count % self.drop_recv_every == 0)
+        # Partition: from frame N, sever EVERYTHING on this channel for
+        # partition_s seconds — the host pair is cut, not slowed. The
+        # peer keeps sending (it is alive), so the master's failure
+        # detector must declare it suspect, and the post-heal late
+        # frames must dedupe — suspect != dead, proven.
+        if (self.partition_after
+                and count == self.partition_after
+                and self.acquire("partition", self.partition_times)):
+            chan._chaos_partition_until = (
+                time.monotonic() + self.partition_s)
+            try:
+                from fiber_tpu.telemetry.flightrec import FLIGHT
+
+                FLIGHT.record("transport", "partition",
+                              cid=getattr(chan, "cid", None),
+                              s=self.partition_s,
+                              reason="chaos: host pair severed")
+            except Exception:
+                pass
+        until = getattr(chan, "_chaos_partition_until", 0.0)
+        if until and time.monotonic() < until:
+            drop = True
         return stall_s, drop
 
     def on_recv_frame(self, chan) -> bool:

@@ -286,3 +286,109 @@ def test_chaos_soak_repeated_kills(chaos_plan):
         xs = list(range(600))
         assert pool.map(targets.square, xs, chunksize=4) == \
             [x * x for x in xs]
+
+
+@pytest.mark.parametrize("io", ["threads", "selector"])
+def test_partition_severs_then_heals_endpoint_level(chaos_plan, io):
+    """Network partition at the Endpoint boundary, both I/O engines:
+    from the N-th frame the host pair is CUT — every frame (data,
+    results, heartbeats) is severed for partition_s — then flow
+    resumes. The schedule comes from the same `recv_frame_actions`
+    both engines consult, so it cannot diverge between them."""
+    from fiber_tpu import serialization
+    from fiber_tpu.transport.tcp import Endpoint
+
+    chaos_plan(partition_after=4, partition_s=1.0, partition_times=1)
+    server = Endpoint("r", io=io)
+    addr = server.bind("127.0.0.1")
+    client = Endpoint("w", io=io).connect(addr)
+    try:
+        t0 = time.monotonic()
+        for i in range(10):
+            client.send(serialization.dumps(i), timeout=10.0)
+        got = [serialization.loads(server.recv(timeout=5.0))
+               for _ in range(3)]
+        assert got == [0, 1, 2]  # pre-partition frames flow
+        # frames 3..9 landed inside the partition window: severed
+        with pytest.raises(TimeoutError):
+            server.recv(timeout=0.3)
+        # heal, then traffic flows again — the peer was never dead
+        time.sleep(max(0.0, t0 + 1.2 - time.monotonic()))
+        client.send(serialization.dumps("after"), timeout=10.0)
+        assert serialization.loads(server.recv(timeout=5.0)) == "after"
+    finally:
+        client.close()
+        server.close()
+
+
+def test_partition_suspect_not_dead_map_completes(chaos_plan):
+    """Suspect != dead, proven: one worker's result stream is severed
+    (results AND heartbeats) for longer than suspect_timeout. The
+    failure detector declares it dead — correctly, silence IS the
+    signal — and its chunks are resubmitted to the surviving worker;
+    the partitioned worker is still alive, and whatever it sends after
+    the heal is deduped. The map completes with exactly one result per
+    task."""
+    plan = chaos_plan(partition_after=6, partition_s=3.0,
+                      partition_times=1)
+    fiber_tpu.init(heartbeat_interval=HB_INTERVAL,
+                   suspect_timeout=1.2)
+    with fiber_tpu.Pool(2) as pool:
+        xs = list(range(60))
+        assert pool.map(targets.square, xs, chunksize=2) == \
+            [x * x for x in xs]
+        assert pool._detector.suspected_total >= 1
+    assert plan.spent("partition") == 1
+
+
+@pytest.mark.slow
+def test_soak_partition_plus_master_kill_then_resume(chaos_plan,
+                                                     tmp_path):
+    """The full durability gauntlet under one seed (docs/robustness.md):
+    a subprocess master runs a durable map while (a) one worker's
+    result stream is partitioned past suspect_timeout and (b) the
+    seeded kill_master knob SIGKILLs the master once >= 4 chunks are
+    journaled. `fiber-tpu`-style resume (re-entering map with the same
+    job_id) then completes the job: exactly one result per task,
+    journaled chunks restored, only the remainder re-executed."""
+    import json
+    import subprocess
+    import sys
+
+    from fiber_tpu.store import ledger as ledgermod
+
+    job = f"soak-part-{os.getpid()}-{SEED}"
+    plan = chaos_plan(partition_after=6, partition_s=2.5,
+                      partition_times=1,
+                      kill_master_after_chunks=4, kill_master_times=1)
+    script = (
+        "import fiber_tpu\n"
+        "from tests import targets\n"
+        "fiber_tpu.init(worker_lite=True, heartbeat_interval=0.2,\n"
+        "               suspect_timeout=1.2)\n"
+        "with fiber_tpu.Pool(2) as pool:\n"
+        f"    pool.map(targets.sleep_echo, list(range(64)), chunksize=2,\n"
+        f"             job_id={job!r})\n"
+    )
+    env = dict(os.environ, FIBER_BACKEND="local")
+    proc = subprocess.run(
+        [sys.executable, "-c", script], env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == -9, (proc.returncode, proc.stderr[-2000:])
+    assert plan.spent("kill-master") == 1
+    header, completed, done = ledgermod.load(ledgermod.job_path(job))
+    assert not done and len(completed) >= 4
+    journaled = len(completed)
+    chaos.uninstall()
+    time.sleep(1.0)  # orphaned subprocess workers notice and exit
+    with fiber_tpu.Pool(2) as pool:
+        out = pool.map(targets.sleep_echo, list(range(64)), chunksize=2,
+                       job_id=job)
+        stats = pool.stats()
+    assert out == list(range(64))
+    assert stats["tasks_restored"] >= 2 * journaled
+    assert stats["tasks_restored"] + stats["tasks_completed"] == 64
+    _, completed_after, done_after = ledgermod.load(
+        ledgermod.job_path(job))
+    assert done_after and len(completed_after) == 32

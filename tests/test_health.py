@@ -166,3 +166,32 @@ def test_breaker_backoff_caps_and_jitters():
     with br._lock:
         remaining = br._state["k"][2] - time.monotonic()
     assert remaining <= 0.31, remaining
+
+
+def test_detector_on_revive_callback_clears_external_state():
+    """Regression (docs/robustness.md): host revival must clear stale
+    per-host state — the TPU backend hangs its breaker reset on this
+    hook, so a recovered host isn't parked by an open breaker earned
+    while it was down."""
+    breaker = CircuitBreaker(fail_threshold=1, base_backoff=30.0,
+                             max_backoff=60.0)
+    revived = []
+
+    def on_revive(peer):
+        revived.append(peer)
+        breaker.record_success(peer)
+
+    det = FailureDetector(0.2, lambda p: None, permanent=False,
+                          on_revive=on_revive).start()
+    try:
+        breaker.record_failure("h1")
+        assert not breaker.allow("h1")  # open for 30s+ unless cleared
+        det.beat("h1")
+        assert _wait_for(lambda: det.is_suspect("h1"))
+        det.beat("h1")  # the peer answers again
+        assert revived == ["h1"]
+        assert not det.is_suspect("h1")
+        assert breaker.allow("h1")
+        assert breaker.state("h1") == "closed"
+    finally:
+        det.stop()

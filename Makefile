@@ -50,9 +50,10 @@ bench-store:
 	JAX_PLATFORMS=cpu python bench.py --store | tee BENCH_store.json
 
 # Telemetry-plane overhead gate (docs/observability.md): small-task pool
-# throughput with telemetry off / metrics-only / full tracing; FAILS
-# when full-tracing overhead exceeds 5% on the microbench. The record
-# lands in BENCH_telemetry.json either way.
+# throughput with telemetry off / metrics-only / full tracing / +flight
+# recorder / +continuous monitor / +sampling profiler; FAILS when the
+# tracing, flightrec, monitor or profiler arm exceeds 5% overhead on
+# the microbench. The record lands in BENCH_telemetry.json either way.
 bench-telemetry:
 	JAX_PLATFORMS=cpu python bench.py --telemetry > BENCH_telemetry.json; \
 	rc=$$?; cat BENCH_telemetry.json; exit $$rc

@@ -238,18 +238,30 @@ def _telemetry_bench(args) -> int:
     import fiber_tpu
 
     n_tasks, duration, workers = 600, 0.001, 4
-    # The flightrec arm isolates the flight recorder's marginal cost:
-    # the lower modes pin it OFF so "tracing" keeps measuring exactly
-    # what it measured before the recorder existed, and "flightrec" is
-    # tracing + the recorder fully on (every plane hook emitting).
+    # Each arm isolates ONE layer's marginal cost: the lower modes pin
+    # everything above them OFF so "tracing" keeps measuring exactly
+    # what it measured before the recorder existed, "flightrec" is
+    # tracing + the recorder fully on (every plane hook emitting),
+    # "monitor" adds the continuous sampler + anomaly watchdog at a
+    # 4x-tighter-than-default interval, and "profiler" adds the
+    # ~100 Hz stack sampler in the master AND every worker.
     modes = (
         ("off", dict(telemetry_enabled=False)),
         ("metrics", dict(telemetry_enabled=True, trace_sample_rate=0.0,
-                         flightrec_enabled=False)),
+                         flightrec_enabled=False,
+                         monitor_enabled=False)),
         ("tracing", dict(telemetry_enabled=True, trace_sample_rate=1.0,
-                         flightrec_enabled=False)),
+                         flightrec_enabled=False,
+                         monitor_enabled=False)),
         ("flightrec", dict(telemetry_enabled=True, trace_sample_rate=1.0,
-                           flightrec_enabled=True)),
+                           flightrec_enabled=True,
+                           monitor_enabled=False)),
+        ("monitor", dict(telemetry_enabled=True, trace_sample_rate=1.0,
+                         flightrec_enabled=True, monitor_enabled=True,
+                         monitor_interval_s=0.25)),
+        ("profiler", dict(telemetry_enabled=True, trace_sample_rate=1.0,
+                          flightrec_enabled=True, monitor_enabled=True,
+                          monitor_interval_s=0.25, profiler_hz=97.0)),
     )
     walls = {}
     for mode, overrides in modes:
@@ -268,24 +280,23 @@ def _telemetry_bench(args) -> int:
                "tasks": n_tasks, "task_s": duration,
                "wall_s": round(best, 4)})
     fiber_tpu.init()
-    metrics_overhead = round(walls["metrics"] / walls["off"], 4)
-    tracing_overhead = round(walls["tracing"] / walls["off"], 4)
-    flightrec_overhead = round(walls["flightrec"] / walls["off"], 4)
-    over = tracing_overhead > _TELEMETRY_BUDGET
-    fr_over = flightrec_overhead > _TELEMETRY_BUDGET
+    overheads = {mode: round(walls[mode] / walls["off"], 4)
+                 for mode in walls if mode != "off"}
+    gated = ("tracing", "flightrec", "monitor", "profiler")
+    over = {mode: overheads[mode] > _TELEMETRY_BUDGET for mode in gated}
     _emit({"metric": "pool_telemetry_overhead",
-           "value": tracing_overhead, "unit": "x vs off",
-           "metrics_only_overhead": metrics_overhead,
-           "flightrec_overhead": flightrec_overhead,
+           "value": overheads["tracing"], "unit": "x vs off",
+           "metrics_only_overhead": overheads["metrics"],
+           "flightrec_overhead": overheads["flightrec"],
+           "monitor_overhead": overheads["monitor"],
+           "profiler_overhead": overheads["profiler"],
            "budget": _TELEMETRY_BUDGET,
-           "over_budget": bool(over or fr_over)})
-    if over:
-        print(f"FAIL: full-tracing overhead {tracing_overhead} exceeds "
-              f"budget {_TELEMETRY_BUDGET}", file=sys.stderr)
-    if fr_over:
-        print(f"FAIL: flight-recorder overhead {flightrec_overhead} "
-              f"exceeds budget {_TELEMETRY_BUDGET}", file=sys.stderr)
-    return 1 if (over or fr_over) else 0
+           "over_budget": any(over.values())})
+    for mode in gated:
+        if over[mode]:
+            print(f"FAIL: {mode} overhead {overheads[mode]} exceeds "
+                  f"budget {_TELEMETRY_BUDGET}", file=sys.stderr)
+    return 1 if any(over.values()) else 0
 
 
 #: Minimum straggler-scenario speedup (speculation on vs off) the

@@ -90,6 +90,33 @@ class LocalBackend(Backend):
 
         return {"local": telemetry.snapshot()}
 
+    def cluster_timeseries(self, history: int = 120) -> dict:
+        """Continuous-monitor snapshot, same one-host shape as
+        :meth:`cluster_metrics` (docs/observability.md)."""
+        from fiber_tpu.telemetry.monitor import monitor_payload
+        from fiber_tpu.telemetry.timeseries import TIMESERIES
+
+        if TIMESERIES.enabled:
+            TIMESERIES.sample_once()
+        return {"local": monitor_payload(history=int(history))}
+
+    def collect_profiles(self, seconds: float = 1.0,
+                         hz: float = 97.0) -> dict:
+        """On-demand sampling profile of this process, same one-host
+        shape as the tpu backend's agent sweep."""
+        import os
+
+        from fiber_tpu.telemetry import tracing
+        from fiber_tpu.telemetry.profiler import PROFILER
+
+        return {"local": {
+            "host": tracing.host_id(),
+            "pid": os.getpid(),
+            "hz": float(hz),
+            "folded": PROFILER.sample_for(seconds, hz),
+            "standing": PROFILER.snapshot(),
+        }}
+
     def list_jobs(self) -> List[Job]:
         with self._lock:
             return [

@@ -620,11 +620,32 @@ class TpuBackend(Backend):
         :meth:`store_stats` (one operator surface), via each agent's
         ``telemetry_snapshot`` op. An unreachable host contributes an
         ``error`` entry instead of failing the sweep."""
+        return self._sweep("telemetry_snapshot")
+
+    def cluster_timeseries(self, history: int = 120) -> Dict[str, dict]:
+        """Per-host continuous-monitor snapshots (time-series rings,
+        derived rates, anomaly-watchdog state) via each agent's
+        ``monitor_snapshot`` op — the data plane of ``fiber-tpu top``,
+        keyed like :meth:`cluster_metrics`."""
+        return self._sweep("monitor_snapshot", int(history))
+
+    def collect_profiles(self, seconds: float = 1.0,
+                         hz: float = 97.0) -> Dict[str, dict]:
+        """Per-host on-demand sampling profiles (agent ``profile_dump``
+        op): each agent samples its own process for ``seconds`` at
+        ``hz`` and returns flamegraph folded stacks. Same host keys as
+        the other sweeps; an unreachable host contributes ``error``."""
+        return self._sweep("profile_dump", float(seconds), float(hz))
+
+    def _sweep(self, op: str, *args) -> Dict[str, dict]:
+        """One telemetry RPC against every host, error-isolating — the
+        shared shape of cluster_metrics / cluster_timeseries /
+        collect_profiles."""
         out: Dict[str, dict] = {}
         for host in self._hosts:
             key = f"{host[0]}:{host[1]}"
             try:
-                out[key] = self._agent(host).call("telemetry_snapshot")
+                out[key] = self._agent(host).call(op, *args)
             except Exception as exc:  # noqa: BLE001 - operator snapshot
                 out[key] = {"error": repr(exc)}
         return out

@@ -17,7 +17,14 @@ up             print (or execute) the commands that start agents on every
                host of a pod slice via gcloud ssh
 status         ping every host agent and report liveness/host info
 metrics        fetch every agent's telemetry snapshot (counters/timers;
-               --prom renders Prometheus v0.0.4 text exposition)
+               --prom renders Prometheus v0.0.4 text exposition;
+               --watch polls and prints deltas/rates between snapshots)
+top            live auto-refreshing per-host table (evals/s, inflight,
+               queue, bytes/s, heartbeat age, anomaly flags) from the
+               agents' continuous-monitor plane
+profile        run a script under the wall-clock sampling profiler (or,
+               with --hosts, pull on-demand agent profiles) and write
+               flamegraph folded output
 explain        classify where a traced map's time went (straggler /
                locality-miss / backpressure / transport-stall /
                store-fetch) from a trace artifact + flight events
@@ -630,16 +637,13 @@ def cmd_doctor(args) -> int:
     return rc
 
 
-def cmd_metrics(args) -> int:
-    """Fetch every host agent's telemetry snapshot and render it —
-    human-readable counters by default, ``--prom`` for Prometheus
-    v0.0.4 text exposition (host-labeled), ``--json`` for the raw
-    snapshots (docs/observability.md)."""
+def _fetch_snapshots(hosts):
+    """One ``telemetry_snapshot`` sweep; returns ``(snaps, rc)``."""
     from fiber_tpu.backends.tpu import AgentClient
 
     rc = 0
     snaps = {}
-    for host, port in _resolve_cli_hosts(args):
+    for host, port in hosts:
         key = f"{host}:{port}"
         client = AgentClient(host, port)
         try:
@@ -649,6 +653,65 @@ def cmd_metrics(args) -> int:
             rc = 1
         finally:
             client.close()
+    return snaps, rc
+
+
+def _metrics_watch(args, hosts) -> int:
+    """``fiber-tpu metrics --watch <secs>``: poll consecutive snapshots
+    and print what MOVED between them as deltas/rates (the timeseries
+    plane's rate math — docs/observability.md "Continuous
+    monitoring") instead of raw counter values."""
+    from fiber_tpu.telemetry.timeseries import snapshot_deltas
+
+    interval = float(args.watch)
+    rounds = int(args.count) if args.count else 0
+    prev = {}
+    prev_t = None
+    n = 0
+    rc = 0
+    try:
+        while True:
+            snaps, poll_rc = _fetch_snapshots(hosts)
+            rc = max(rc, poll_rc)
+            now = time.monotonic()
+            if prev_t is not None:
+                dt = now - prev_t
+                stamp = time.strftime("%H:%M:%S")
+                print(f"-- {stamp}  (+{dt:.1f}s)")
+                for key, snap in snaps.items():
+                    deltas = snapshot_deltas(
+                        (prev.get(key) or {}).get("metrics", {}),
+                        snap.get("metrics", {}), dt)
+                    if not deltas:
+                        print(f"{key}  (no movement)")
+                        continue
+                    print(key)
+                    for name, d in sorted(deltas.items()):
+                        if d["kind"] == "gauge":
+                            print(f"  {name} {d['value']:g} "
+                                  f"({d['delta']:+g})")
+                        else:
+                            print(f"  {name} +{d['delta']:g} "
+                                  f"({d['rate']:g}/s)")
+            prev, prev_t = snaps, now
+            n += 1
+            if rounds and n > rounds:
+                return rc
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return rc
+
+
+def cmd_metrics(args) -> int:
+    """Fetch every host agent's telemetry snapshot and render it —
+    human-readable counters by default, ``--prom`` for Prometheus
+    v0.0.4 text exposition (host-labeled), ``--json`` for the raw
+    snapshots, ``--watch <secs>`` to poll and print deltas/rates
+    between consecutive snapshots (docs/observability.md)."""
+    hosts = _resolve_cli_hosts(args)
+    if args.watch > 0:
+        return _metrics_watch(args, hosts)
+    snaps, rc = _fetch_snapshots(hosts)
     if args.json:
         print(json.dumps(snaps, indent=2, default=str))
         return rc
@@ -677,6 +740,189 @@ def cmd_metrics(args) -> int:
     return rc
 
 
+def _render_top_rows(pulls) -> list:
+    """Monitor snapshots -> aligned table rows (one per host). Shared
+    by cmd_top and its tests; anomaly flags come from each host's
+    watchdog active set."""
+    rows = []
+    for key in sorted(pulls):
+        pull = pulls[key]
+        if not isinstance(pull, dict) or "error" in pull:
+            err = (pull or {}).get("error", "no data") \
+                if isinstance(pull, dict) else "no data"
+            rows.append(f"{key:<22} DOWN  ({str(err)[:60]})")
+            continue
+        last = (pull.get("timeseries") or {}).get("last") or {}
+        anomalies = (pull.get("anomalies") or {}).get("active") or {}
+        ages = pull.get("heartbeat_ages") or {}
+        flags = ",".join(sorted(anomalies)) if anomalies else "-"
+        rows.append(
+            f"{key:<22} "
+            f"{last.get('tasks_per_s', 0.0):>8.1f} "
+            f"{int(last.get('inflight', 0)):>9d} "
+            f"{int(last.get('queue_depth', 0)):>7d} "
+            f"{_human_bytes(last.get('bytes_tx_per_s', 0.0)):>10}/s "
+            f"{_human_bytes(last.get('bytes_rx_per_s', 0.0)):>10}/s "
+            f"{max(ages.values(), default=0.0):>7.2f}s "
+            f"{flags}")
+    return rows
+
+
+def _human_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024.0 or unit == "GB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n:.0f}B"
+        n /= 1024.0
+    return f"{n:.1f}GB"  # pragma: no cover - unreachable
+
+
+_TOP_HEADER = (f"{'HOST':<22} {'EVALS/S':>8} {'INFLIGHT':>9} "
+               f"{'QUEUE':>7} {'TX':>12} {'RX':>12} {'HB-AGE':>8} "
+               "ANOMALIES")
+
+
+def cmd_top(args) -> int:
+    """``fiber-tpu top``: live auto-refreshing per-host table from the
+    agents' continuous-monitor plane (docs/observability.md) — evals/s,
+    in-flight tasks, queue depth, wire rates, heartbeat age and the
+    anomaly watchdog's active flags. ``--iterations N`` renders N
+    frames and exits (0 = until Ctrl-C); anomalies across hosts are
+    merge-ordered on (wall, monotonic)."""
+    from fiber_tpu.backends.tpu import AgentClient
+    from fiber_tpu.telemetry.flightrec import order_events
+
+    hosts = _resolve_cli_hosts(args)
+    frames = 0
+    rc = 0
+    try:
+        while True:
+            pulls = {}
+            for host, port in hosts:
+                key = f"{host}:{port}"
+                client = AgentClient(host, port)
+                try:
+                    pulls[key] = client.call("monitor_snapshot",
+                                             int(args.history))
+                except Exception as err:  # noqa: BLE001
+                    pulls[key] = {"error": repr(err)}
+                    rc = 1
+                finally:
+                    client.close()
+            if args.json:
+                print(json.dumps(pulls, default=str))
+            else:
+                if frames and not args.no_clear:
+                    sys.stdout.write("\x1b[2J\x1b[H")
+                print(f"fiber-tpu top — {len(hosts)} host(s) — "
+                      f"{time.strftime('%H:%M:%S')}")
+                print(_TOP_HEADER)
+                for row in _render_top_rows(pulls):
+                    print(row)
+                # Recent anomalies, newest last, merged across hosts on
+                # the wall clock with the monotonic tiebreak.
+                recent = []
+                for key, pull in pulls.items():
+                    if not isinstance(pull, dict):
+                        continue
+                    for rec in ((pull.get("anomalies") or {})
+                                .get("recent") or []):
+                        rec = dict(rec)
+                        rec.setdefault("ts", rec.get("wall", 0.0))
+                        rec["host"] = key
+                        recent.append(rec)
+                for rec in order_events(recent)[-args.last:]:
+                    stamp = time.strftime(
+                        "%H:%M:%S", time.localtime(rec.get("wall", 0)))
+                    print(f"  [{stamp}] {rec['host']} "
+                          f"{rec.get('rule')}: {rec.get('detail')}")
+                sys.stdout.flush()
+            frames += 1
+            if args.iterations and frames >= args.iterations:
+                return rc
+            time.sleep(float(args.interval))
+    except KeyboardInterrupt:
+        return rc
+
+
+def cmd_profile(args) -> int:
+    """``fiber-tpu profile``: wall-clock sampling profiles as
+    flamegraph folded output (docs/observability.md "Sampling
+    profiler"). Two modes:
+
+    * ``fiber-tpu profile script.py [args…] --out prof.folded`` — run
+      the script with the profiler armed in this process AND every
+      fiber_tpu worker it spawns (the workers' stacks ship back on the
+      result stream); the merged cluster profile lands in --out.
+    * ``fiber-tpu profile --hosts … --out prof.folded`` — no script:
+      pull an on-demand burst profile from every host agent.
+    """
+    from fiber_tpu.telemetry import profiler as profmod
+
+    hz = float(args.hz)
+    if hz <= 0:
+        raise SystemExit("error: --hz must be > 0")
+    if not args.script:
+        if not (args.hosts or getattr(args, "tpu", "")):
+            raise SystemExit(
+                "error: pass a script to profile, or --hosts to pull "
+                "agent profiles")
+        from fiber_tpu.backends.tpu import AgentClient
+
+        rc = 0
+        merged: dict = {}
+        for host, port in _resolve_cli_hosts(args):
+            key = f"{host}:{port}"
+            client = AgentClient(host, port)
+            try:
+                pull = client.call("profile_dump", float(args.seconds), hz)
+            except Exception as err:  # noqa: BLE001
+                print(f"{key}  DOWN  ({err})", file=sys.stderr)
+                rc = 1
+                continue
+            finally:
+                client.close()
+            # Host-prefix each stack so the merged flamegraph keeps
+            # per-host attribution as its root frames.
+            for stack, count in (pull.get("folded") or {}).items():
+                pre = f"host:{key};{stack}"
+                merged[pre] = merged.get(pre, 0) + count
+        _write_profile(args, merged, hz)
+        return rc
+    # Script mode: arm the profiler via the config env so this process
+    # and every spawned worker inherit it (config ships in spawn prep).
+    os.environ["FIBER_PROFILER_HZ"] = str(hz)
+    import fiber_tpu
+
+    fiber_tpu.init()
+    try:
+        _run_script(args.script, args.script_args)
+    except SystemExit as err:
+        if err.code not in (0, None):
+            print(f"profile: script exited {err.code}", file=sys.stderr)
+    finally:
+        profmod.PROFILER.set_hz(0.0)
+    merged = profmod.merge_folded(profmod.PROFILER.snapshot(),
+                                  profmod.AGGREGATE.merged())
+    _write_profile(args, merged, hz)
+    return 0
+
+
+def _write_profile(args, folded: dict, hz: float) -> None:
+    from fiber_tpu.telemetry import profiler as profmod
+
+    out = args.out or "prof.folded"
+    with open(out, "w") as fh:
+        fh.write(profmod.folded_text(folded))
+    samples = sum(folded.values())
+    print(f"profile: {samples} sample(s), {len(folded)} stack(s) "
+          f"-> {out}", file=sys.stderr)
+    if args.chrome:
+        profmod.write_chrome_profile(args.chrome, folded, hz)
+        print(f"profile: chrome flame view -> {args.chrome}",
+              file=sys.stderr)
+
+
 def cmd_explain(args) -> int:
     """Join a trace artifact (``Pool.trace_dump`` Chrome JSON or a raw
     span list) with flight events (``Pool.flight_dump``) and print the
@@ -694,10 +940,19 @@ def cmd_explain(args) -> int:
         except (OSError, ValueError) as err:
             raise SystemExit(
                 f"error: cannot load flight events: {err}") from None
+    profile = None
+    if getattr(args, "profile", ""):
+        from fiber_tpu.telemetry import profiler as profmod
+
+        try:
+            profile = profmod.load_folded(args.profile)
+        except (OSError, ValueError) as err:
+            raise SystemExit(
+                f"error: cannot load profile: {err}") from None
     try:
         verdict = explainmod.explain_trace(
             spans, events, trace_id=args.trace_id or None,
-            quantile=args.quantile)
+            quantile=args.quantile, profile=profile)
     except ValueError as err:
         raise SystemExit(f"error: {err}") from None
     if args.json:
@@ -1020,7 +1275,65 @@ def build_parser() -> argparse.ArgumentParser:
                         "(host-labeled)")
     p.add_argument("--json", action="store_true",
                    help="print the raw per-host snapshots as JSON")
+    p.add_argument("--watch", type=float, default=0.0,
+                   help="poll every N seconds and print deltas/rates "
+                        "between consecutive snapshots instead of raw "
+                        "counters")
+    p.add_argument("--count", type=int, default=0,
+                   help="with --watch: delta rounds to print before "
+                        "exiting (0 = until Ctrl-C)")
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser(
+        "top", help="live per-host table: evals/s, inflight, queue, "
+                    "bytes/s, heartbeat age, anomaly flags")
+    p.add_argument("--hosts", default="")
+    p.add_argument("--tpu", default="",
+                   help="TPU name: derive worker addresses via gcloud "
+                        "describe when --hosts is absent")
+    p.add_argument("--zone", default="")
+    p.add_argument("--port", type=int, default=0,
+                   help="port for portless --hosts entries / derived "
+                        "addresses")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between refreshes")
+    p.add_argument("--iterations", type=int, default=0,
+                   help="frames to render before exiting "
+                        "(0 = until Ctrl-C)")
+    p.add_argument("--history", type=int, default=120,
+                   help="time-series points pulled per host")
+    p.add_argument("--last", type=int, default=8,
+                   help="recent anomalies shown under the table")
+    p.add_argument("--no-clear", action="store_true",
+                   help="append frames instead of clearing the screen")
+    p.add_argument("--json", action="store_true",
+                   help="print raw per-host monitor snapshots as JSON")
+    p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser(
+        "profile", help="sampling profiler: run a script under it, or "
+                        "pull on-demand agent profiles (--hosts)")
+    p.add_argument("script", nargs="?", default="",
+                   help="script to run under the profiler (omit with "
+                        "--hosts to pull agent profiles instead)")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    p.add_argument("--out", default="prof.folded",
+                   help="flamegraph folded output path")
+    p.add_argument("--chrome", default="",
+                   help="also write a Chrome-trace flame view here")
+    p.add_argument("--hz", type=float, default=97.0,
+                   help="stack samples per second")
+    p.add_argument("--seconds", type=float, default=1.0,
+                   help="with --hosts: burst duration per agent")
+    p.add_argument("--hosts", default="")
+    p.add_argument("--tpu", default="",
+                   help="TPU name: derive worker addresses via gcloud "
+                        "describe when --hosts is absent")
+    p.add_argument("--zone", default="")
+    p.add_argument("--port", type=int, default=0,
+                   help="port for portless --hosts entries / derived "
+                        "addresses")
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("explain",
                        help="classify where a traced map's time went "
@@ -1038,6 +1351,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--quantile", type=float, default=2.0,
                    help="straggler threshold: chunks slower than this "
                         "multiple of the map median are blamed")
+    p.add_argument("--profile", default="",
+                   help="folded sampling profile (Pool.profile_dump / "
+                        "fiber-tpu profile output): a compute verdict "
+                        "then names the top frames")
     p.add_argument("--json", action="store_true",
                    help="print the raw verdict as JSON")
     p.set_defaults(fn=cmd_explain)

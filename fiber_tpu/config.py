@@ -159,6 +159,37 @@ DEFAULTS: Dict[str, Any] = {
     # Events kept in the ring before the oldest fall out (each is a
     # small dict; 2048 bounds a long-lived master to ~1 MB).
     "flightrec_buffer_size": 2048,
+    # --- continuous monitor plane (docs/observability.md) ---
+    # Per-process sampler thread that snapshots the hot instruments
+    # (tasks/s, bytes/s, queue depth, inflight, heartbeat age) every
+    # monitor_interval_s into bounded time-series rings, and the
+    # anomaly watchdog that rides it. Off: no thread, no rings, the
+    # only cost is one check per telemetry.refresh(). Requires
+    # telemetry_enabled (one master switch for the plane).
+    "monitor_enabled": True,
+    "monitor_interval_s": 1.0,
+    # Points kept per series ring (600 x 1s = a 10-minute window).
+    "monitor_history": 600,
+    # Wall-clock sampling profiler (telemetry/profiler.py): > 0 arms a
+    # per-process sampler at this many stack samples per second,
+    # aggregated as flamegraph folded stacks; pool workers ship theirs
+    # back on the result stream. 0 (default) = off, zero cost. The
+    # armed cost is gated <= 5% by `make bench-telemetry`'s profiler
+    # arm at ~100 Hz.
+    "profiler_hz": 0.0,
+    # Anomaly watchdog rules (telemetry/monitor.py). tasks/s dropping
+    # more than this fraction below its trailing-window mean (with
+    # work in flight) raises `throughput_drop`:
+    "anomaly_drop_pct": 0.5,
+    # Consecutive samples of monotonic queue-depth growth that raise
+    # `queue_growth`:
+    "anomaly_queue_intervals": 5,
+    # Transport egress queue bytes (MB) past which `tx_queue_high`
+    # raises (half the 32 MiB per-channel TX_HIGH_WATER block):
+    "anomaly_tx_queue_mb": 16.0,
+    # Store disk-tier fill fraction (of max_disk_bytes) past which
+    # `store_disk_fill` raises:
+    "anomaly_disk_fill_pct": 0.9,
     # --- TPU backend ---
     "tpu_name": "",
     "tpu_zone": "",

@@ -32,6 +32,7 @@ from __future__ import annotations
 import random
 import threading
 import time
+import weakref
 from typing import Callable, Dict, Iterable, Optional
 
 from fiber_tpu import telemetry
@@ -58,6 +59,31 @@ _m_breaker_opens = telemetry.counter(
     "health_breaker_opens", "Circuit-breaker open transitions")
 _g_breaker_open = telemetry.gauge(
     "health_breaker_open_keys", "Keys currently held open by a breaker")
+
+#: Live failure detectors in this process. The monitor plane
+#: (telemetry/timeseries + the anomaly watchdog) reads per-peer
+#: heartbeat AGES through this registry so a peer drifting toward its
+#: suspect deadline is visible *before* the declaration fires. Weak:
+#: a stopped pool's detector must not be pinned alive by telemetry.
+DETECTORS: "weakref.WeakSet[FailureDetector]" = weakref.WeakSet()
+
+
+def heartbeat_ages() -> Dict[str, float]:
+    """Seconds since the last beat of every tracked peer across every
+    live detector, keyed by the flight-safe peer label. Suspected
+    (already-declared) peers are excluded — they are the health plane's
+    problem; this surface is for trouble still brewing."""
+    out: Dict[str, float] = {}
+    for detector in list(DETECTORS):
+        try:
+            if detector._stop.is_set():
+                continue  # a stopped pool's peers are not "silent"
+            for peer, age in detector.ages().items():
+                label = _peer_label(peer)
+                out[label] = max(age, out.get(label, 0.0))
+        except Exception:  # noqa: BLE001 - monitoring must not fail
+            continue
+    return out
 
 
 class Heartbeater:
@@ -143,6 +169,7 @@ class FailureDetector:
             target=self._loop, name=name, daemon=True
         )
         self.suspected_total = 0  # lifetime declarations (observable)
+        DETECTORS.add(self)
 
     def start(self) -> "FailureDetector":
         self._thread.start()
@@ -189,6 +216,13 @@ class FailureDetector:
     def is_suspect(self, peer) -> bool:
         with self._lock:
             return peer in self._dead
+
+    def ages(self) -> Dict[object, float]:
+        """Seconds of silence per still-tracked peer (monitor plane;
+        peers already declared dead are not listed)."""
+        now = time.monotonic()
+        with self._lock:
+            return {p: now - seen for p, seen in self._last_seen.items()}
 
     def peers(self) -> Iterable:
         with self._lock:

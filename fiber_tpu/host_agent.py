@@ -488,6 +488,39 @@ class HostAgent:
 
         return telemetry.snapshot()
 
+    def _op_monitor_snapshot(self, history: int = 120) -> dict:
+        """Continuous-monitor surface for this host: time-series rings,
+        derived rates, heartbeat ages and the anomaly watchdog state —
+        the per-host payload of ``TpuBackend.cluster_timeseries`` and
+        the ``fiber-tpu top`` row (docs/observability.md). An
+        extra-fresh sample is taken when the sampler is armed so `top`
+        never renders a tick-old rate."""
+        from fiber_tpu.telemetry.monitor import monitor_payload
+        from fiber_tpu.telemetry.timeseries import TIMESERIES
+
+        if TIMESERIES.enabled:
+            TIMESERIES.sample_once()
+        return monitor_payload(history=int(history))
+
+    def _op_profile_dump(self, seconds: float = 1.0,
+                         hz: float = 97.0) -> dict:
+        """On-demand sampling profile of THIS process (bounded burst;
+        docs/observability.md "Sampling profiler"). When the standing
+        profiler is armed (``profiler_hz`` > 0) its aggregate rides
+        along so ``fiber-tpu profile --hosts`` sees history too."""
+        from fiber_tpu.telemetry import tracing
+        from fiber_tpu.telemetry.profiler import PROFILER
+
+        folded = PROFILER.sample_for(seconds, hz)
+        return {
+            "host": tracing.host_id(),
+            "pid": os.getpid(),
+            "hz": float(hz),
+            "seconds": min(max(0.0, float(seconds)), 30.0),
+            "folded": folded,
+            "standing": PROFILER.snapshot(),
+        }
+
     def _op_host_info(self) -> dict:
         return {
             "pid": os.getpid(),

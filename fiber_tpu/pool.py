@@ -952,6 +952,26 @@ def _pool_worker_core(
                         ("spans", ident, finished)))
                 except (TransportClosed, OSError):
                     pass
+
+            def _ship_profile() -> None:
+                # Sampling-profiler stacks ride the result stream too
+                # (docs/observability.md "Sampling profiler"): drain so
+                # each frame carries only samples the master hasn't
+                # seen. Unlike spans this is NOT tied to the map's
+                # trace sampling — the profiler has its own hz knob.
+                from fiber_tpu.telemetry.profiler import PROFILER
+
+                if not PROFILER.active:
+                    return
+                folded = PROFILER.drain()
+                if not folded:
+                    return
+                try:
+                    result_ep.send(serialization.dumps(
+                        ("prof", ident,
+                         f"{tracing.host_id()}:{fiber_pid}", folded)))
+                except (TransportClosed, OSError):
+                    pass
             plan = chaos._plan
             if plan is not None:
                 # Hang BEFORE compute (the held chunk is what the
@@ -1013,6 +1033,7 @@ def _pool_worker_core(
                 serialization.dumps(("result", seq, base, values, ident))
             )
             _ship_spans()
+            _ship_profile()
             completed_chunks += 1
             if plan is not None:
                 plan.maybe_kill_worker(completed_chunks)
@@ -1162,6 +1183,16 @@ class Pool:
         self._terminated = False
         self._workers_started = False
         self._pool_meta: Optional[Dict[str, Any]] = None
+
+        # Continuous monitor plane (docs/observability.md): the sampler
+        # pulls queue-depth/inflight through this probe each tick so
+        # the time-series (and the watchdog's queue-growth rule) never
+        # read a stale gauge. Registered unconditionally — with the
+        # monitor off the probe list is simply never walked.
+        from fiber_tpu.telemetry.timeseries import TIMESERIES
+
+        self._monitor_probe = self._update_monitor_gauges
+        TIMESERIES.add_probe(self._monitor_probe)
 
         self._result_thread = threading.Thread(
             target=self._result_loop, name="fiber-pool-results", daemon=True
@@ -1462,6 +1493,18 @@ class Pool:
                     if detector is not None:
                         detector.beat(msg[1])
                     tracing.SPANS.add_all(msg[2])
+                    continue
+                if msg[0] == "prof":
+                    # Worker-side sampling-profiler stacks (same
+                    # posture as spans): merge into the master's
+                    # cluster aggregate, keyed by the worker's
+                    # host:pid label (Pool.profile_dump renders it).
+                    _, ident, label, folded = msg
+                    if detector is not None:
+                        detector.beat(ident)
+                    from fiber_tpu.telemetry.profiler import AGGREGATE
+
+                    AGGREGATE.merge(label, folded)
                     continue
                 if msg[0] == "storemiss":
                     _, seq, base, n, ident = msg
@@ -1879,9 +1922,59 @@ class Pool:
         """Snapshot of the process metrics registry (every plane's
         counters, not just this pool's) — the master-side sibling of the
         host agent's ``telemetry_snapshot`` op."""
+        self._update_monitor_gauges()
+        return telemetry.REGISTRY.snapshot()
+
+    def _update_monitor_gauges(self) -> None:
+        """Push this pool's pull-style state into the registry gauges
+        (the monitor sampler's per-tick probe; also run by metrics())."""
         _g_queue_depth.set(self._taskq.qsize())
         _g_inflight.set(self._store.outstanding())
-        return telemetry.REGISTRY.snapshot()
+
+    def timeseries(self) -> Dict[str, Any]:
+        """This process's continuous-monitor surface: the sampled
+        time-series rings, the latest derived rates (tasks/s, bytes/s,
+        heartbeat age) and the anomaly watchdog's state — the
+        master-side sibling of the host agent's ``monitor_snapshot``
+        op (docs/observability.md "Continuous monitoring")."""
+        from fiber_tpu.telemetry.monitor import monitor_payload
+        from fiber_tpu.telemetry.timeseries import TIMESERIES
+
+        self._update_monitor_gauges()
+        if TIMESERIES.enabled:
+            # Extra-fresh tick (same posture as the agent's
+            # monitor_snapshot op): results that landed since the last
+            # interval must be in the surface the caller reads NOW.
+            TIMESERIES.sample_once()
+        return monitor_payload()
+
+    def profiles(self) -> Dict[str, int]:
+        """Merged cluster profile (flamegraph folded stacks -> sample
+        counts): this process's sampler aggregate plus every profile
+        frame the workers shipped back on the result stream. Empty
+        unless ``profiler_hz`` > 0 (docs/observability.md "Sampling
+        profiler")."""
+        from fiber_tpu.telemetry import profiler as profmod
+
+        return profmod.merge_folded(profmod.PROFILER.snapshot(),
+                                    profmod.AGGREGATE.merged())
+
+    def profile_dump(self, path: str, chrome: bool = False) -> str:
+        """Write the merged cluster profile — flamegraph folded text by
+        default (``flamegraph.pl``/speedscope/Perfetto ingest it), or
+        the Chrome-trace flame view with ``chrome=True``. Returns
+        ``path``."""
+        from fiber_tpu.telemetry import profiler as profmod
+
+        folded = self.profiles()
+        if chrome:
+            from fiber_tpu import config as _cfg
+
+            hz = float(_cfg.get().profiler_hz) or 97.0
+            return profmod.write_chrome_profile(path, folded, hz)
+        with open(path, "w") as fh:
+            fh.write(profmod.folded_text(folded))
+        return path
 
     def trace_dump(self, path: str) -> str:
         """Write the process span store — master spans plus every worker
@@ -2325,6 +2418,9 @@ class Pool:
         self._shutdown_transport()
 
     def _shutdown_transport(self) -> None:
+        from fiber_tpu.telemetry.timeseries import TIMESERIES
+
+        TIMESERIES.remove_probe(self._monitor_probe)
         self._taskq.put(None)
         self._sched.close()
         self._task_ep.close()

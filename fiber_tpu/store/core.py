@@ -386,6 +386,25 @@ class LocalStore:
         except OSError:
             pass
 
+    def disk_usage(self) -> int:
+        """Bytes currently held by the disk tier (spill + host cache),
+        0 when the tier is disabled — the monitor watchdog's
+        ``store_disk_fill`` input, checked against max_disk_bytes."""
+        if self.root is None:
+            return 0
+        total = 0
+        try:
+            for name in os.listdir(self.root):
+                if not name.endswith(".obj"):
+                    continue
+                try:
+                    total += os.path.getsize(os.path.join(self.root, name))
+                except OSError:
+                    continue
+        except OSError:
+            return 0
+        return total
+
     # -- introspection --------------------------------------------------
     def stats(self) -> Dict[str, int]:
         with self._lock:

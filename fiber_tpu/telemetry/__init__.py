@@ -36,6 +36,8 @@ from fiber_tpu.telemetry.metrics import (  # noqa: F401
 )
 from fiber_tpu.telemetry import tracing  # noqa: F401
 from fiber_tpu.telemetry.flightrec import FLIGHT  # noqa: F401
+from fiber_tpu.telemetry.profiler import PROFILER  # noqa: F401
+from fiber_tpu.telemetry.timeseries import TIMESERIES  # noqa: F401
 from fiber_tpu.telemetry.tracing import (  # noqa: F401
     SPANS,
     current_trace_id,
@@ -101,6 +103,21 @@ def refresh() -> None:
         and bool(cfg.flightrec_enabled)
     if FLIGHT._events.maxlen != int(cfg.flightrec_buffer_size):
         FLIGHT.resize(int(cfg.flightrec_buffer_size))
+    # Continuous monitor plane (docs/observability.md): the sampler
+    # thread + anomaly watchdog ride the same master switch; the
+    # profiler arms on its own hz knob. Lazy import keeps the module
+    # graph acyclic (monitor registers instruments against THIS
+    # module).
+    from fiber_tpu.telemetry.monitor import WATCHDOG
+
+    WATCHDOG.configure(cfg)
+    TIMESERIES.add_observer(WATCHDOG.observe)
+    TIMESERIES.configure(
+        enabled=bool(cfg.telemetry_enabled) and bool(cfg.monitor_enabled),
+        interval=float(cfg.monitor_interval_s),
+        capacity=int(cfg.monitor_history))
+    PROFILER.set_hz(
+        float(cfg.profiler_hz) if cfg.telemetry_enabled else 0.0)
 
 
 def snapshot() -> Dict[str, Any]:
@@ -130,6 +147,10 @@ def snapshot() -> Dict[str, Any]:
         "flight_buffered": len(FLIGHT),
         "flight_recorded": FLIGHT.recorded,
         "flight_dropped": FLIGHT.dropped,
+        "monitor": TIMESERIES.last_sample(),
+        "monitor_samples": TIMESERIES.samples,
+        "profiler_hz": PROFILER.hz,
+        "profiler_samples": PROFILER.samples,
         "sched": sched_snaps,
     }
 

@@ -83,14 +83,18 @@ def load_spans(path: str) -> List[Dict[str, Any]]:
 
 def load_events(path: str) -> List[Dict[str, Any]]:
     """Flight events from a file: a JSON list, or the ``Pool.flight_dump``
-    envelope ``{"events": [...]}``."""
+    envelope ``{"events": [...]}``. Events are merge-ordered on
+    ``(wall, monotonic)`` — artifacts concatenated from several
+    processes interleave correctly (flightrec.order_events)."""
+    from fiber_tpu.telemetry.flightrec import order_events
+
     with open(path) as fh:
         doc = json.load(fh)
     if isinstance(doc, dict):
         doc = doc.get("events", [])
     if not isinstance(doc, list):
         raise ValueError(f"{path!r} holds no flight-event list")
-    return doc
+    return order_events(doc)
 
 
 def _dominant_trace(spans: Sequence[Dict[str, Any]]) -> Optional[str]:
@@ -110,7 +114,9 @@ def _median(values: Sequence[float]) -> float:
 def explain_trace(spans: Sequence[Dict[str, Any]],
                   events: Iterable[Dict[str, Any]] = (),
                   trace_id: Optional[str] = None,
-                  quantile: float = 2.0) -> Dict[str, Any]:
+                  quantile: float = 2.0,
+                  profile: Optional[Dict[str, int]] = None
+                  ) -> Dict[str, Any]:
     """Classify one trace's time. ``trace_id`` defaults to the trace
     with the most spans (the artifact usually holds exactly the traced
     map plus stragglers of earlier ones)."""
@@ -192,6 +198,17 @@ def explain_trace(spans: Sequence[Dict[str, Any]],
     ranked = sorted(((c, budget[c]) for c in CATEGORIES),
                     key=lambda kv: kv[1], reverse=True)
     primary = ranked[0][0] if ranked[0][1] > 0.0 else "compute"
+    if profile:
+        # A sampling profile (folded stacks — telemetry/profiler.py)
+        # makes a compute verdict actionable: the evidence names WHICH
+        # Python frames burned the samples instead of stopping at
+        # "compute".
+        from fiber_tpu.telemetry.profiler import top_frames
+
+        evidence["compute_frames"] = [
+            {"frame": frame, "samples": count}
+            for frame, count in top_frames(profile, 5)
+        ]
     return {
         "trace": trace_id,
         "wall_s": round(t1 - t0, 6),
@@ -225,4 +242,10 @@ def render(verdict: Dict[str, Any]) -> str:
             f"straggler evidence: {ev['outliers']}/{ev['chunks']} outlier "
             f"chunk(s) vs median {ev['median_s']:.4f}s, "
             f"{ev['speculations']} speculation(s) [{ev['source']}]")
+    frames = verdict.get("evidence", {}).get("compute_frames")
+    if frames and verdict.get("primary") == "compute":
+        lines.append("compute is the verdict — top sampled frames:")
+        for entry in frames:
+            lines.append(
+                f"  {entry['samples']:>6}  {entry['frame']}")
     return "\n".join(lines)

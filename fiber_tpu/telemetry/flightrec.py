@@ -64,8 +64,14 @@ class FlightRecorder:
         the recorder is off."""
         if not self.enabled:
             return
-        event: Dict[str, Any] = {"ts": time.time(), "plane": plane,
-                                 "kind": kind}
+        # Dual clocks on every event: "ts" (wall) is comparable across
+        # hosts but subject to NTP steps; "mono" orders events from ONE
+        # process exactly. Cross-process merges (explain --flight, the
+        # monitor plane) sort on (ts, mono) — wall first, monotonic as
+        # the same-process tiebreak (see order_events).
+        event: Dict[str, Any] = {"ts": time.time(),
+                                 "mono": time.monotonic(),
+                                 "plane": plane, "kind": kind}
         if attrs:
             event.update(attrs)
         with self._lock:
@@ -109,3 +115,13 @@ FLIGHT = FlightRecorder()
 def record(plane: str, kind: str, **attrs: Any) -> None:
     """Module-level convenience for cold call sites."""
     FLIGHT.record(plane, kind, **attrs)
+
+
+def order_events(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Merge-order flight events from one or many processes: wall
+    clock first (the only axis comparable across hosts), monotonic
+    clock as the tiebreak (exact within a process, where wall-clock
+    resolution or an NTP step can produce equal/backwards ``ts``).
+    Events recorded before the dual-clock stamp sort by wall alone."""
+    return sorted(events, key=lambda e: (float(e.get("ts", 0.0)),
+                                         float(e.get("mono", 0.0))))

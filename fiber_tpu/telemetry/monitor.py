@@ -1,0 +1,250 @@
+"""Anomaly watchdog: automatic detection of the failure modes the
+chaos harness injects (docs/observability.md "Anomaly rules").
+
+The watchdog rides the monitor sampler (:mod:`.timeseries`): every
+``monitor_interval_s`` tick it receives the derived sample and checks a
+small fixed rule set. A breach is an **edge event** — it fires once
+when the rule newly trips (flight-recorder event on the new
+``monitor`` plane, a log warning, and the ``monitor_anomalies``
+counter) and clears when the signal recovers, so a long incident
+doesn't spam one warning per tick. ``fiber-tpu top`` renders the
+active set per host; ``snapshot()`` ships it through the agent's
+``monitor_snapshot`` op.
+
+Rules (knobs in config.py, docs/observability.md):
+
+==================  ====================================================
+throughput_drop     tasks/s fell more than ``anomaly_drop_pct`` below
+                    the trailing-window mean while work is in flight —
+                    the signature of a stuck/slowed worker
+                    (chaos ``slow_worker_*``)
+queue_growth        dispatch queue depth grew monotonically for
+                    ``anomaly_queue_intervals`` consecutive samples —
+                    submission outrunning the fleet
+heartbeat_age       a peer has been silent longer than
+                    ``suspect_timeout / 2`` — trouble brewing *before*
+                    the failure detector declares (chaos
+                    ``partition_*``)
+store_disk_fill     the object store's disk tier is past
+                    ``anomaly_disk_fill_pct`` of its bound — spill is
+                    about to start failing
+tx_queue_high       egress bytes queued in the transport exceed
+                    ``anomaly_tx_queue_mb`` — a peer is not draining
+==================  ====================================================
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Deque, Dict, List, Optional
+
+from fiber_tpu import telemetry
+from fiber_tpu.telemetry.flightrec import FLIGHT
+from fiber_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+_m_anomalies = telemetry.counter(
+    "monitor_anomalies", "Watchdog rule breaches, by rule")
+
+#: Trailing-window length (samples) for the throughput baseline.
+TREND_WINDOW = 5
+
+#: Recent anomaly records kept for the operator surface.
+MAX_RECENT = 256
+
+
+class AnomalyWatchdog:
+    """Rule evaluation over monitor samples; see module docstring."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # knobs (refreshed from config via configure())
+        self.drop_pct = 0.5
+        self.queue_intervals = 5
+        self.tx_queue_bytes = 16 << 20
+        self.disk_fill_pct = 0.9
+        self.suspect_timeout = 10.0
+        # state
+        self._rates: Deque[float] = collections.deque(
+            maxlen=TREND_WINDOW + 1)
+        self._queue_depths: Deque[float] = collections.deque(maxlen=64)
+        self._active: Dict[str, Dict[str, Any]] = {}
+        self._recent: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=MAX_RECENT)
+        self.total = 0  # lifetime breaches
+
+    def configure(self, cfg) -> None:
+        """Re-read the anomaly knobs (telemetry.refresh)."""
+        self.drop_pct = min(0.99, max(0.01, float(cfg.anomaly_drop_pct)))
+        self.queue_intervals = max(2, int(cfg.anomaly_queue_intervals))
+        self.tx_queue_bytes = int(float(cfg.anomaly_tx_queue_mb) * (1 << 20))
+        self.disk_fill_pct = min(1.0, max(0.05,
+                                          float(cfg.anomaly_disk_fill_pct)))
+        self.suspect_timeout = float(cfg.suspect_timeout or 0.0)
+
+    # -- breach bookkeeping --------------------------------------------
+    def _raise_anomaly(self, rule: str, detail: str,
+                       **attrs: Any) -> None:
+        record = {
+            "rule": rule, "detail": detail,
+            "wall": time.time(), "mono": time.monotonic(),
+        }
+        record.update(attrs)
+        self._active[rule] = record
+        self._recent.append(record)
+        self.total += 1
+        _m_anomalies.inc(rule=rule)
+        FLIGHT.record("monitor", rule, detail=detail, **attrs)
+        logger.warning("monitor: anomaly %s — %s", rule, detail)
+
+    def _clear_anomaly(self, rule: str) -> None:
+        if self._active.pop(rule, None) is not None:
+            FLIGHT.record("monitor", "clear", rule=rule)
+            logger.info("monitor: anomaly %s cleared", rule)
+
+    def _edge(self, rule: str, breached: bool, detail: str = "",
+              **attrs: Any) -> None:
+        if breached and rule not in self._active:
+            self._raise_anomaly(rule, detail, **attrs)
+        elif not breached and rule in self._active:
+            self._clear_anomaly(rule)
+
+    # -- the sampler callback ------------------------------------------
+    def observe(self, sample: Dict[str, Any]) -> None:
+        with self._lock:
+            self._observe_locked(sample)
+
+    def _observe_locked(self, sample: Dict[str, Any]) -> None:
+        # 1. throughput collapse vs the trailing window
+        rate = float(sample.get("tasks_per_s") or 0.0)
+        inflight = float(sample.get("inflight") or 0.0)
+        trailing = list(self._rates)
+        self._rates.append(rate)
+        baseline = (sum(trailing) / len(trailing)) if trailing else 0.0
+        breached = (
+            len(trailing) >= TREND_WINDOW
+            and baseline > 0.0
+            and inflight > 0.0
+            and rate < (1.0 - self.drop_pct) * baseline
+        )
+        self._edge(
+            "throughput_drop", breached,
+            detail=(f"tasks/s {rate:.1f} < "
+                    f"{(1.0 - self.drop_pct):.2f}x trailing "
+                    f"{baseline:.1f} with {inflight:.0f} in flight"),
+            rate=round(rate, 3), baseline=round(baseline, 3))
+        if breached:
+            # A collapsed rate must not drag the baseline down to the
+            # collapse level (which would self-clear the anomaly while
+            # the worker is still stuck): freeze the window.
+            self._rates.pop()
+
+        # 2. queue depth monotonically growing
+        depth = float(sample.get("queue_depth") or 0.0)
+        self._queue_depths.append(depth)
+        n = self.queue_intervals
+        window = list(self._queue_depths)[-(n + 1):]
+        growing = (
+            len(window) >= n + 1
+            and all(b > a for a, b in zip(window, window[1:]))
+        )
+        self._edge(
+            "queue_growth", growing,
+            detail=(f"dispatch queue grew {window[0]:.0f} -> "
+                    f"{window[-1]:.0f} over {n} intervals"),
+            depth=depth)
+
+        # 3. heartbeat age past half the suspect deadline
+        age = float(sample.get("heartbeat_age_s") or 0.0)
+        threshold = self.suspect_timeout / 2.0
+        self._edge(
+            "heartbeat_age",
+            self.suspect_timeout > 0 and age > threshold,
+            detail=(f"oldest peer silence {age:.2f}s > "
+                    f"suspect_timeout/2 ({threshold:.2f}s)"),
+            age_s=round(age, 3))
+
+        # 4. store disk-tier fill (only when a store exists — probing
+        # must not instantiate one)
+        usage, bound = _store_disk_usage()
+        self._edge(
+            "store_disk_fill",
+            bound > 0 and usage > self.disk_fill_pct * bound,
+            detail=(f"store disk tier {usage >> 20}MB > "
+                    f"{self.disk_fill_pct:.0%} of {bound >> 20}MB"),
+            bytes=usage)
+
+        # 5. transport egress queue high water
+        txq = float(sample.get("tx_queue_bytes") or 0.0)
+        self._edge(
+            "tx_queue_high", txq > self.tx_queue_bytes,
+            detail=(f"tx queue {int(txq) >> 20}MB > "
+                    f"{self.tx_queue_bytes >> 20}MB — a peer is not "
+                    "draining"),
+            bytes=int(txq))
+
+    # -- read side -----------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "active": {r: dict(rec)
+                           for r, rec in self._active.items()},
+                "recent": [dict(r) for r in self._recent],
+                "total": self.total,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._active.clear()
+            self._recent.clear()
+            self._rates.clear()
+            self._queue_depths.clear()
+            self.total = 0
+
+
+def _store_disk_usage() -> "tuple[int, int]":
+    """(bytes used, bound) of the process store's disk tier; (0, 0)
+    when no store has been built or it has no disk root."""
+    try:
+        from fiber_tpu import store as storemod
+
+        st = storemod._store  # peek, never instantiate
+        if st is None or st.root is None:
+            return 0, 0
+        return st.disk_usage(), int(st.max_disk_bytes)
+    except Exception:  # noqa: BLE001 - monitoring must not fail
+        return 0, 0
+
+
+#: Process-wide watchdog; registered as a TIMESERIES observer by
+#: telemetry.refresh().
+WATCHDOG = AnomalyWatchdog()
+
+
+def monitor_payload(history: int = 120) -> Dict[str, Any]:
+    """The per-host monitor surface: latest derived sample + bounded
+    ring history + the watchdog state + per-peer heartbeat ages. One
+    shape shared by the host agent's ``monitor_snapshot`` op, the
+    local backend's ``cluster_timeseries`` and ``Pool.timeseries()``
+    so `fiber-tpu top` renders any source identically."""
+    import os as _os
+
+    from fiber_tpu import health
+    from fiber_tpu.telemetry import tracing
+    from fiber_tpu.telemetry.timeseries import TIMESERIES
+
+    try:
+        ages = {str(k): round(v, 3)
+                for k, v in health.heartbeat_ages().items()}
+    except Exception:  # noqa: BLE001
+        ages = {}
+    return {
+        "host": tracing.host_id(),
+        "pid": _os.getpid(),
+        "timeseries": TIMESERIES.snapshot(last=int(history)),
+        "anomalies": WATCHDOG.snapshot(),
+        "heartbeat_ages": ages,
+    }

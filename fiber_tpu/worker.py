@@ -80,7 +80,10 @@ def _apply_preparation(prep: dict) -> None:
     # Telemetry enablement / sampling / span-buffer capacity follow the
     # master's config, adopted above — so one knob governs the whole
     # process tree, and spans this worker records (pool.py task loop)
-    # join the trace ids the master stamps into task envelopes.
+    # join the trace ids the master stamps into task envelopes. The
+    # same refresh arms the continuous monitor sampler and, when
+    # profiler_hz > 0, this worker's wall-clock stack sampler (its
+    # folded stacks ship back on the result stream — pool.py).
     from fiber_tpu import telemetry
 
     telemetry.refresh()

@@ -29,6 +29,15 @@ def sleep_forever() -> None:
         time.sleep(3600)
 
 
+def spin_for(seconds: float):
+    """CPU-bound busy loop (the sampling-profiler tests' unit of work:
+    the worker must be ON-cpu so wall-clock samples land in it)."""
+    deadline = time.perf_counter() + seconds
+    while time.perf_counter() < deadline:
+        sum(i * i for i in range(200))
+    return seconds
+
+
 def exit_with(code: int) -> None:
     sys.exit(code)
 

@@ -51,9 +51,10 @@ bench-store:
 
 # Telemetry-plane overhead gate (docs/observability.md): small-task pool
 # throughput with telemetry off / metrics-only / full tracing / +flight
-# recorder / +continuous monitor / +sampling profiler; FAILS when the
-# tracing, flightrec, monitor or profiler arm exceeds 5% overhead on
-# the microbench. The record lands in BENCH_telemetry.json either way.
+# recorder / +continuous monitor / +device telemetry plane / +sampling
+# profiler; FAILS when the tracing, flightrec, monitor, device or
+# profiler arm exceeds 5% overhead on the microbench. The record lands
+# in BENCH_telemetry.json either way.
 bench-telemetry:
 	JAX_PLATFORMS=cpu python bench.py --telemetry > BENCH_telemetry.json; \
 	rc=$$?; cat BENCH_telemetry.json; exit $$rc

@@ -249,19 +249,31 @@ def _telemetry_bench(args) -> int:
         ("off", dict(telemetry_enabled=False)),
         ("metrics", dict(telemetry_enabled=True, trace_sample_rate=0.0,
                          flightrec_enabled=False,
-                         monitor_enabled=False)),
+                         monitor_enabled=False,
+                         device_telemetry_enabled=False)),
         ("tracing", dict(telemetry_enabled=True, trace_sample_rate=1.0,
                          flightrec_enabled=False,
-                         monitor_enabled=False)),
+                         monitor_enabled=False,
+                         device_telemetry_enabled=False)),
         ("flightrec", dict(telemetry_enabled=True, trace_sample_rate=1.0,
                            flightrec_enabled=True,
-                           monitor_enabled=False)),
+                           monitor_enabled=False,
+                           device_telemetry_enabled=False)),
         ("monitor", dict(telemetry_enabled=True, trace_sample_rate=1.0,
                          flightrec_enabled=True, monitor_enabled=True,
-                         monitor_interval_s=0.25)),
+                         monitor_interval_s=0.25,
+                         device_telemetry_enabled=False)),
+        # device = monitor + the device telemetry plane fully on:
+        # transfer accounting armed on every worker's resolve path and
+        # the HBM/live-array gauge probe riding the 0.25s sampler tick.
+        ("device", dict(telemetry_enabled=True, trace_sample_rate=1.0,
+                        flightrec_enabled=True, monitor_enabled=True,
+                        monitor_interval_s=0.25,
+                        device_telemetry_enabled=True)),
         ("profiler", dict(telemetry_enabled=True, trace_sample_rate=1.0,
                           flightrec_enabled=True, monitor_enabled=True,
-                          monitor_interval_s=0.25, profiler_hz=97.0)),
+                          monitor_interval_s=0.25, profiler_hz=97.0,
+                          device_telemetry_enabled=False)),
     )
     walls = {}
     for mode, overrides in modes:
@@ -282,13 +294,14 @@ def _telemetry_bench(args) -> int:
     fiber_tpu.init()
     overheads = {mode: round(walls[mode] / walls["off"], 4)
                  for mode in walls if mode != "off"}
-    gated = ("tracing", "flightrec", "monitor", "profiler")
+    gated = ("tracing", "flightrec", "monitor", "device", "profiler")
     over = {mode: overheads[mode] > _TELEMETRY_BUDGET for mode in gated}
     _emit({"metric": "pool_telemetry_overhead",
            "value": overheads["tracing"], "unit": "x vs off",
            "metrics_only_overhead": overheads["metrics"],
            "flightrec_overhead": overheads["flightrec"],
            "monitor_overhead": overheads["monitor"],
+           "device_overhead": overheads["device"],
            "profiler_overhead": overheads["profiler"],
            "budget": _TELEMETRY_BUDGET,
            "over_budget": any(over.values())})

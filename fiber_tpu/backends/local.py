@@ -100,6 +100,15 @@ class LocalBackend(Backend):
             TIMESERIES.sample_once()
         return {"local": monitor_payload(history=int(history))}
 
+    def cluster_devices(self) -> dict:
+        """Device-telemetry snapshot, same one-host shape as
+        :meth:`cluster_metrics` (docs/observability.md "Device
+        telemetry")."""
+        from fiber_tpu.telemetry.device import DEVICE
+
+        DEVICE.update_gauges()
+        return {"local": DEVICE.snapshot()}
+
     def collect_profiles(self, seconds: float = 1.0,
                          hz: float = 97.0) -> dict:
         """On-demand sampling profile of this process, same one-host

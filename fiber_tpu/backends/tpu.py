@@ -637,6 +637,15 @@ class TpuBackend(Backend):
         the other sweeps; an unreachable host contributes ``error``."""
         return self._sweep("profile_dump", float(seconds), float(hz))
 
+    def cluster_devices(self) -> Dict[str, dict]:
+        """Per-host device-telemetry snapshots (agent
+        ``device_snapshot`` op): transfer bytes+seconds, compile
+        count+seconds, HBM / live-array stats (honest None on CPU
+        hosts), recompile state and last MFU — the data plane of
+        ``fiber-tpu devices``, keyed like :meth:`cluster_metrics`
+        (docs/observability.md "Device telemetry")."""
+        return self._sweep("device_snapshot")
+
     def _sweep(self, op: str, *args) -> Dict[str, dict]:
         """One telemetry RPC against every host, error-isolating — the
         shared shape of cluster_metrics / cluster_timeseries /

@@ -740,10 +740,27 @@ def cmd_metrics(args) -> int:
     return rc
 
 
+def _render_hbm(device: dict) -> str:
+    """HBM column: 'used/limit' when the host reports memory_stats,
+    '-' honestly otherwise (CPU hosts, no device runtime)."""
+    used = (device or {}).get("hbm_bytes_in_use")
+    limit = (device or {}).get("hbm_bytes_limit")
+    if used is None and limit is None:
+        return "-"
+    used_s = _human_bytes(used) if used is not None else "?"
+    return f"{used_s}/{_human_bytes(limit)}" if limit else used_s
+
+
+def _render_mfu(device: dict) -> str:
+    mfu = (device or {}).get("mfu")
+    return f"{float(mfu):.1%}" if mfu is not None else "-"
+
+
 def _render_top_rows(pulls) -> list:
     """Monitor snapshots -> aligned table rows (one per host). Shared
     by cmd_top and its tests; anomaly flags come from each host's
-    watchdog active set."""
+    watchdog active set; HBM/MFU come from the device telemetry plane
+    (rendered '-' when the host has no device runtime)."""
     rows = []
     for key in sorted(pulls):
         pull = pulls[key]
@@ -755,6 +772,7 @@ def _render_top_rows(pulls) -> list:
         last = (pull.get("timeseries") or {}).get("last") or {}
         anomalies = (pull.get("anomalies") or {}).get("active") or {}
         ages = pull.get("heartbeat_ages") or {}
+        device = pull.get("device") or {}
         flags = ",".join(sorted(anomalies)) if anomalies else "-"
         rows.append(
             f"{key:<22} "
@@ -764,6 +782,8 @@ def _render_top_rows(pulls) -> list:
             f"{_human_bytes(last.get('bytes_tx_per_s', 0.0)):>10}/s "
             f"{_human_bytes(last.get('bytes_rx_per_s', 0.0)):>10}/s "
             f"{max(ages.values(), default=0.0):>7.2f}s "
+            f"{_render_hbm(device):>15} "
+            f"{_render_mfu(device):>6} "
             f"{flags}")
     return rows
 
@@ -779,7 +799,7 @@ def _human_bytes(n: float) -> str:
 
 _TOP_HEADER = (f"{'HOST':<22} {'EVALS/S':>8} {'INFLIGHT':>9} "
                f"{'QUEUE':>7} {'TX':>12} {'RX':>12} {'HB-AGE':>8} "
-               "ANOMALIES")
+               f"{'HBM':>15} {'MFU':>6} ANOMALIES")
 
 
 def cmd_top(args) -> int:
@@ -843,6 +863,80 @@ def cmd_top(args) -> int:
             time.sleep(float(args.interval))
     except KeyboardInterrupt:
         return rc
+
+
+def _render_device_rows(pulls) -> list:
+    """Device snapshots -> aligned table rows (one per host). Shared by
+    cmd_devices and its tests; null HBM/MFU render '-' honestly."""
+    rows = []
+    for key in sorted(pulls):
+        snap = pulls[key]
+        if not isinstance(snap, dict) or "error" in snap:
+            err = (snap or {}).get("error", "no data") \
+                if isinstance(snap, dict) else "no data"
+            rows.append(f"{key:<22} DOWN  ({str(err)[:60]})")
+            continue
+        hbm = snap.get("hbm") or {}
+        mfu = (snap.get("mfu") or {}).get("mfu")
+        live = snap.get("live_arrays") or {}
+        storm = (snap.get("recompile") or {}).get("storm")
+        rows.append(
+            f"{key:<22} "
+            f"{str(snap.get('platform') or '-'):>8} "
+            f"{_human_bytes(snap.get('transfer_bytes', 0)):>10} "
+            f"{float(snap.get('transfer_seconds', 0.0)):>9.3f}s "
+            f"{int(snap.get('compiles', 0)):>8d} "
+            f"{float(snap.get('compile_seconds', 0.0)):>9.3f}s "
+            f"{_render_hbm({'hbm_bytes_in_use': hbm.get('bytes_in_use'), 'hbm_bytes_limit': hbm.get('bytes_limit')}):>15} "
+            f"{(str(live.get('count')) if live.get('count') is not None else '-'):>7} "
+            f"{_render_mfu({'mfu': mfu}):>6} "
+            f"{'STORM' if storm else '-'}")
+    return rows
+
+
+_DEVICES_HEADER = (f"{'HOST':<22} {'PLATFORM':>8} {'XFER-B':>10} "
+                   f"{'XFER-S':>10} {'COMPILES':>8} {'COMPILE-S':>10} "
+                   f"{'HBM':>15} {'ARRAYS':>7} {'MFU':>6} RECOMPILE")
+
+
+def cmd_devices(args) -> int:
+    """``fiber-tpu devices``: per-host device telemetry — transfer
+    bytes/seconds, compile count/seconds, HBM and live-array stats
+    (honest '-' on hosts without a device runtime), recompile-storm
+    state and the last live MFU (docs/observability.md "Device
+    telemetry"). ``--json`` ships the raw per-host snapshots."""
+    from fiber_tpu.backends.tpu import AgentClient
+
+    hosts = _resolve_cli_hosts(args)
+    rc = 0
+    pulls = {}
+    for host, port in hosts:
+        key = f"{host}:{port}"
+        client = AgentClient(host, port)
+        try:
+            pulls[key] = client.call("device_snapshot")
+        except Exception as err:  # noqa: BLE001
+            pulls[key] = {"error": repr(err)}
+            rc = 1
+        finally:
+            client.close()
+    if args.json:
+        print(json.dumps(pulls, default=str))
+        return rc
+    print(_DEVICES_HEADER)
+    for row in _render_device_rows(pulls):
+        print(row)
+    if args.sites:
+        for key, snap in sorted(pulls.items()):
+            if not isinstance(snap, dict) or "error" in snap:
+                continue
+            for site, agg in sorted(
+                    (snap.get("transfers") or {}).items()):
+                print(f"  {key} {site:<16} "
+                      f"n={agg.get('count', 0)} "
+                      f"{_human_bytes(agg.get('bytes', 0))} "
+                      f"{float(agg.get('seconds', 0.0)):.4f}s")
+    return rc
 
 
 def cmd_profile(args) -> int:
@@ -1309,6 +1403,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", action="store_true",
                    help="print raw per-host monitor snapshots as JSON")
     p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser(
+        "devices", help="per-host device telemetry: transfer "
+                        "bytes/seconds, compiles, HBM, live arrays, "
+                        "recompile state, live MFU")
+    p.add_argument("--hosts", default="")
+    p.add_argument("--tpu", default="",
+                   help="TPU name: derive worker addresses via gcloud "
+                        "describe when --hosts is absent")
+    p.add_argument("--zone", default="")
+    p.add_argument("--port", type=int, default=0,
+                   help="port for portless --hosts entries / derived "
+                        "addresses")
+    p.add_argument("--sites", action="store_true",
+                   help="also print per-site transfer accounting "
+                        "(store_resolve / deserialize / dmap / "
+                        "checkpoint)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw per-host snapshots as JSON")
+    p.set_defaults(fn=cmd_devices)
 
     p = sub.add_parser(
         "profile", help="sampling profiler: run a script under it, or "

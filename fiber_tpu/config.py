@@ -190,6 +190,21 @@ DEFAULTS: Dict[str, Any] = {
     # Store disk-tier fill fraction (of max_disk_bytes) past which
     # `store_disk_fill` raises:
     "anomaly_disk_fill_pct": 0.9,
+    # --- device telemetry plane (docs/observability.md) ---
+    # Transfer accounting at the host->device boundary (store resolve,
+    # deserialize, device_map plan, checkpoint restore), jax.monitoring
+    # compile listeners, HBM/live-array gauges and the live pool_map_mfu
+    # gauge. Requires telemetry_enabled; off, every hook is one
+    # attribute check. Gated <= 5% by `make bench-telemetry`'s device
+    # arm.
+    "device_telemetry_enabled": True,
+    # Recompiles of ONE fingerprint inside the window that raise the
+    # `recompile_storm` watchdog rule (shape churn, not progress):
+    "anomaly_recompile_count": 4,
+    "anomaly_recompile_window_s": 30.0,
+    # HBM fill fraction (bytes_in_use / bytes_limit, when the device
+    # reports memory_stats) past which `hbm_fill` raises:
+    "anomaly_hbm_fill_pct": 0.92,
     # --- TPU backend ---
     "tpu_name": "",
     "tpu_zone": "",

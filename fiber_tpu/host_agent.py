@@ -488,6 +488,19 @@ class HostAgent:
 
         return telemetry.snapshot()
 
+    def _op_device_snapshot(self) -> dict:
+        """Device telemetry surface for this host: transfer accounting,
+        compile count/seconds + recompile state, HBM and live-array
+        stats (honest None when this process has no device runtime —
+        the probe never *initializes* a jax backend), and the last live
+        MFU — the per-host payload of ``TpuBackend.cluster_devices``
+        and the ``fiber-tpu devices`` CLI (docs/observability.md
+        "Device telemetry")."""
+        from fiber_tpu.telemetry.device import DEVICE
+
+        DEVICE.update_gauges()  # extra-fresh HBM/live-array probe
+        return DEVICE.snapshot()
+
     def _op_monitor_snapshot(self, history: int = 120) -> dict:
         """Continuous-monitor surface for this host: time-series rings,
         derived rates, heartbeat ages and the anomaly watchdog state —

@@ -17,7 +17,10 @@ from typing import Any, Callable, Dict
 
 META_ATTR = "__fiber_meta__"
 
-VALID_META_KEYS = frozenset({"cpu", "mem", "gpu", "tpu", "device"})
+#: ``flops`` — analytic FLOPs per item (utils/flops.py counters): lets
+#: the pool compute a live MFU for device maps (pool_map_mfu gauge).
+VALID_META_KEYS = frozenset({"cpu", "mem", "gpu", "tpu", "device",
+                             "flops"})
 _RENAMES = {"memory": "mem"}
 
 

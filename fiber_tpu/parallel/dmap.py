@@ -48,6 +48,19 @@ def _stack_items(items: List[Any]):
     return jax.tree.map(lambda *leaves: np.stack(leaves), *items)
 
 
+def _fingerprint(fn: Callable, mesh) -> str:
+    """Stable logical-program identity for the recompile detector: the
+    function's qualified name (not its id — a fresh lambda per call is
+    EXACTLY the storm worth catching, and equal names collapse) plus
+    the mesh shape."""
+    name = getattr(fn, "__qualname__", None) or repr(type(fn).__name__)
+    try:
+        shape = tuple(mesh.shape.items())
+    except Exception:  # noqa: BLE001 - exotic mesh objects
+        shape = ()
+    return f"{getattr(fn, '__module__', '?')}.{name}@{shape}"
+
+
 def _compiled_mapper(fn: Callable, mesh, multi_arg: bool,
                      donate: bool = False):
     """jit(shard_map(vmap(fn))) over the pool axis, cached per
@@ -67,6 +80,13 @@ def _compiled_mapper(fn: Callable, mesh, multi_arg: bool,
             if cached is not None:
                 _compile_cache.move_to_end(key)
                 return cached
+    # A compile-cache miss is a (re)compilation request for this logical
+    # program: the device telemetry plane keys its recompile-storm
+    # detector on this fingerprint (docs/observability.md) — the same
+    # function compiling over and over is shape churn, not progress.
+    from fiber_tpu.telemetry.device import DEVICE
+
+    DEVICE.note_compile(_fingerprint(fn, mesh))
 
     if multi_arg:
         def per_item(packed):
@@ -145,10 +165,18 @@ class DeviceMapPlan:
                     [a, np.repeat(a[-1:], pad, axis=0)]),
                 batched,
             )
-        device_in = jax.tree.map(
-            lambda a: jax.device_put(np.asarray(a), self._sharding),
-            batched,
-        )
+        from fiber_tpu.telemetry.device import DEVICE
+
+        # The per-call host->device transfer of the whole stacked batch
+        # (unavoidable for host-resident items — class docstring);
+        # accounted so explain/devices can see what maps pay for it.
+        total = sum(getattr(np.asarray(a), "nbytes", 0)
+                    for a in jax.tree.leaves(batched))
+        with DEVICE.transfer("dmap", total):
+            device_in = jax.tree.map(
+                lambda a: jax.device_put(np.asarray(a), self._sharding),
+                batched,
+            )
         out = self._compiled(device_in)
         host = jax.device_get(out)
         if not isinstance(host, (np.ndarray, np.generic)):

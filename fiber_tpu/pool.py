@@ -817,6 +817,9 @@ def _pool_worker_core(
     reason = "error"
     next_task = None
     heartbeater = None
+    # Last device-telemetry revision shipped to the master (list so the
+    # per-chunk _ship_device closure can update it).
+    dev_shipped = [0]
     # By-reference payloads: the store client is built lazily on the
     # first ref actually seen (most workers in small maps never pay the
     # import), shared across chunks so broadcast args resolve once per
@@ -972,6 +975,27 @@ def _pool_worker_core(
                          f"{tracing.host_id()}:{fiber_pid}", folded)))
                 except (TransportClosed, OSError):
                     pass
+
+            def _ship_device() -> None:
+                # Device-plane counters (transfer accounting, compile
+                # observability — docs/observability.md "Device
+                # telemetry") ride the result stream like spans and
+                # profiles, but as a CUMULATIVE snapshot keyed host:pid
+                # (latest wins on the master) — shipped only when the
+                # revision moved so idle workers cost nothing.
+                from fiber_tpu.telemetry.device import DEVICE
+
+                if not DEVICE.enabled \
+                        or DEVICE.revision == dev_shipped[0]:
+                    return
+                snap = DEVICE.snapshot()
+                dev_shipped[0] = snap["revision"]
+                try:
+                    result_ep.send(serialization.dumps(
+                        ("dev", ident,
+                         f"{tracing.host_id()}:{fiber_pid}", snap)))
+                except (TransportClosed, OSError):
+                    pass
             plan = chaos._plan
             if plan is not None:
                 # Hang BEFORE compute (the held chunk is what the
@@ -1034,6 +1058,7 @@ def _pool_worker_core(
             )
             _ship_spans()
             _ship_profile()
+            _ship_device()
             completed_chunks += 1
             if plan is not None:
                 plan.maybe_kill_worker(completed_chunks)
@@ -1079,6 +1104,9 @@ class Pool:
         self._n_submitted = 0
         self._n_completed = 0
         self._n_resubmitted = 0
+        #: Latest device-telemetry snapshot per worker (host:pid), from
+        #: the ("dev", ...) result-stream frames — Pool.device_stats().
+        self._device_workers: Dict[str, dict] = {}
         if processes is None:
             processes = get_backend().default_pool_size()
         if processes < 1:
@@ -1505,6 +1533,16 @@ class Pool:
                     from fiber_tpu.telemetry.profiler import AGGREGATE
 
                     AGGREGATE.merge(label, folded)
+                    continue
+                if msg[0] == "dev":
+                    # Worker-side device-telemetry snapshots (transfer
+                    # accounting, compiles — docs/observability.md
+                    # "Device telemetry"): cumulative per worker, so
+                    # latest wins; Pool.device_stats() renders them.
+                    _, ident, label, snap = msg
+                    if detector is not None:
+                        detector.beat(ident)
+                    self._device_workers[str(label)] = snap
                     continue
                 if msg[0] == "storemiss":
                     _, seq, base, n, ident = msg
@@ -1976,14 +2014,62 @@ class Pool:
             fh.write(profmod.folded_text(folded))
         return path
 
-    def trace_dump(self, path: str) -> str:
+    def device_stats(self) -> Dict[str, Any]:
+        """Device telemetry plane surface (docs/observability.md
+        "Device telemetry"): per-process transfer bytes+seconds (by
+        site), compile count+seconds, recompile state, HBM and
+        live-array stats (honest ``None`` on CPU), and the last live
+        MFU — for the master, every worker that shipped ``("dev", …)``
+        frames on the result stream, and every cluster host (the
+        backend's ``cluster_devices`` agent sweep, same host keys as
+        ``host_health``/``store_stats``)."""
+        from fiber_tpu.backends import get_backend
+        from fiber_tpu.telemetry.device import DEVICE
+
+        out: Dict[str, Any] = {
+            "master": DEVICE.snapshot(),
+            "workers": {k: dict(v)
+                        for k, v in self._device_workers.items()},
+        }
+        cluster = getattr(get_backend(), "cluster_devices", None)
+        if cluster is not None:
+            try:
+                out["hosts"] = cluster()
+            except Exception as exc:  # noqa: BLE001 - operator surface
+                out["hosts"] = {"error": repr(exc)}
+        return out
+
+    def trace_dump(self, path: str,
+                   xla_dir: Optional[str] = None) -> str:
         """Write the process span store — master spans plus every worker
         span shipped back on the result stream — as Chrome trace-event
         JSON loadable in Perfetto / chrome://tracing (pid = host,
-        tid = worker pid). Returns ``path``."""
+        tid = worker pid). When an XLA profiler capture exists —
+        ``xla_dir=`` names its log directory, or a
+        ``utils.profiling.trace`` region ran in this process (the
+        device plane notes the newest capture) — its device ops merge
+        in beside the host spans on the dual clock
+        (docs/observability.md "Unified timeline"). Returns ``path``."""
         from fiber_tpu.telemetry import export
+        from fiber_tpu.telemetry.device import DEVICE
 
-        return export.write_chrome_trace(path, tracing.SPANS.snapshot())
+        spans = tracing.SPANS.snapshot()
+        wall_start = None
+        if xla_dir is None:
+            noted = DEVICE.last_xla_trace()
+            if noted is not None:
+                cand_dir, cand_wall, _mono = noted
+                # Auto-merge only a capture that OVERLAPS this dump's
+                # span window: a profiling.trace region from minutes
+                # ago must not glue stale device ops onto an unrelated
+                # map's timeline (an explicit xla_dir= always merges).
+                t0 = min((float(sp.get("ts", 0.0)) for sp in spans),
+                         default=None)
+                if t0 is None or cand_wall >= t0 - 60.0:
+                    xla_dir, wall_start = cand_dir, cand_wall
+        return export.write_chrome_trace(path, spans,
+                                         xla_dir=xla_dir,
+                                         xla_wall_start=wall_start)
 
     def flight_dump(self, path: str) -> str:
         """Write this process's flight-recorder buffer (pool submits and
@@ -2204,7 +2290,22 @@ class Pool:
                 "@meta(device=True) requires the fiber_tpu.parallel "
                 "device path"
             ) from err
-        return device_map(func, items, star=star)
+        t0 = time.perf_counter()
+        out = device_map(func, items, star=star)
+        wall = time.perf_counter() - t0
+        # Live MFU (docs/observability.md "Device telemetry"): a
+        # function declaring its analytic cost (@meta(device=True,
+        # flops=<per item>) — utils/flops.py counters supply the
+        # number) lands its achieved MFU in the pool_map_mfu gauge
+        # whenever the device peak resolves; CPU runs record None
+        # honestly, exactly the bench-cluster posture.
+        flops_per_item = get_meta(func).get("flops")
+        if flops_per_item and items:
+            from fiber_tpu.telemetry.device import DEVICE
+
+            DEVICE.note_map_flops(float(flops_per_item) * len(items),
+                                  wall, len(items))
+        return out
 
     def _dispatch_async(self, func, items, star, chunksize,
                         callback, error_callback, priority=1.0,

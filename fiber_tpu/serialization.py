@@ -66,7 +66,13 @@ def _jax_array_reduce(arr):
 def _jax_array_rebuild(host):
     import jax
 
-    return jax.device_put(host)
+    from fiber_tpu.telemetry.device import DEVICE
+
+    # The device boundary of every pickled jax.Array (store resolution,
+    # result deserialize): accounted per-site so `fiber-tpu explain`
+    # can blame transfer seconds (docs/observability.md).
+    with DEVICE.transfer("deserialize", getattr(host, "nbytes", 0)):
+        return jax.device_put(host)
 
 
 _jax_reducer_registered = False

@@ -261,7 +261,15 @@ class StoreClient:
             self._count("obj_cache_hits")
             return obj
         data = self.fetch_bytes(ref)
-        obj = serialization.loads(data)
+        # Store resolution is a host->device boundary: deserializing a
+        # broadcast payload is where its arrays land on the device
+        # (device telemetry plane, docs/observability.md). Accounted
+        # once per worker per object — the resolution cache above keeps
+        # repeat tasks free.
+        from fiber_tpu.telemetry.device import DEVICE
+
+        with DEVICE.transfer("store_resolve", len(data)):
+            obj = serialization.loads(data)
         self._objs[ref.digest] = obj
         self._obj_order.append(ref.digest)
         while len(self._obj_order) > self._obj_cap:

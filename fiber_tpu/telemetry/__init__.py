@@ -118,6 +118,14 @@ def refresh() -> None:
         capacity=int(cfg.monitor_history))
     PROFILER.set_hz(
         float(cfg.profiler_hz) if cfg.telemetry_enabled else 0.0)
+    # Device telemetry plane (docs/observability.md "Device telemetry"):
+    # transfer accounting, jax.monitoring compile listeners, and the
+    # HBM/live-array gauges the monitor sampler reads each tick. Lazy
+    # import, same posture as monitor above.
+    from fiber_tpu.telemetry.device import DEVICE
+
+    DEVICE.configure(cfg)
+    TIMESERIES.add_probe(DEVICE.update_gauges)
 
 
 def snapshot() -> Dict[str, Any]:
@@ -152,7 +160,19 @@ def snapshot() -> Dict[str, Any]:
         "profiler_hz": PROFILER.hz,
         "profiler_samples": PROFILER.samples,
         "sched": sched_snaps,
+        "device": _device_snapshot(),
     }
+
+
+def _device_snapshot() -> Dict[str, Any]:
+    """Device-plane surface for the generic snapshot (null-safe: a
+    snapshot must never fail, and must never initialize a backend)."""
+    try:
+        from fiber_tpu.telemetry.device import DEVICE
+
+        return DEVICE.snapshot()
+    except Exception:  # pragma: no cover - snapshot must never fail
+        return {}
 
 
 def serve_metrics(port: int = 0, bind: str = "127.0.0.1"):

@@ -1,0 +1,464 @@
+"""Device telemetry plane: visibility into the JAX device boundary
+(docs/observability.md "Device telemetry").
+
+The five CPU planes are deeply observable, but the thing this framework
+exists to drive — the device plane — was a black box: every
+``jax.device_put`` untimed, HBM usage invisible, a recompile storm
+indistinguishable from slow compute, MFU only computed inside
+``make bench-cluster``. This module is the missing instrument panel:
+
+* **Transfer accounting** — :func:`transfer` wraps the host→device
+  boundary (store resolution, serialization deserialize, the device_map
+  plan, checkpoint restore) and records per-site
+  ``device_transfer_seconds`` / ``device_transfer_bytes`` histograms,
+  a tracing span when a trace context is ambient, and a flight event —
+  so ``fiber-tpu explain`` can grow a ``transfer`` blame category.
+* **Compile observability** — ``jax.monitoring`` event/duration
+  listeners (null-safe shim in :mod:`fiber_tpu.utils.jaxcompat` for
+  jax versions without it) count compiles and compile seconds, and a
+  fingerprint-keyed recompile detector feeds the watchdog's
+  ``recompile_storm`` rule: the SAME logical function compiling over
+  and over is shape churn, not progress.
+* **Device gauges** — per-process HBM ``memory_stats()``
+  (bytes_in_use / limit; honestly ``None`` on CPU and older jaxlib),
+  live-array count/bytes, pushed into the registry each monitor tick
+  so the PR-8 time-series and the ``hbm_fill`` anomaly rule see them.
+* **Live MFU** — whenever a device peak resolves
+  (:mod:`fiber_tpu.utils.flops`), per-map achieved FLOP/s divide into
+  the ``pool_map_mfu`` gauge; CPU runs record ``None`` honestly.
+
+Design constraints, mirrored from the rest of the plane:
+
+* **Near-zero when off** — ``device_telemetry_enabled=False`` (or the
+  telemetry master switch) reduces every hook to one attribute check;
+  the fully-on cost is gated ≤ 5% by ``make bench-telemetry``'s
+  ``device`` arm.
+* **Null-safe everywhere** — no probe may *initialize* a jax backend
+  (``jax`` absent from ``sys.modules`` means every device field is
+  ``None``), and a CPU ``memory_stats()`` returning None/empty records
+  ``None`` honestly instead of raising — the bench-cluster MFU
+  posture.
+* **Picklable snapshots** — :func:`snapshot` is the payload of the
+  host agent's ``device_snapshot`` op, ``cluster_devices()`` on both
+  backends, the worker's ``("dev", …)`` result-stream frames, and
+  ``Pool.device_stats()``.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+from fiber_tpu import telemetry
+from fiber_tpu.telemetry import tracing
+from fiber_tpu.telemetry.flightrec import FLIGHT
+
+# Registry twins (docs/observability.md metric catalog). Histograms for
+# both axes: the bucket shape answers "are transfers many-small or
+# few-huge" and sum/count give the totals the snapshots expose.
+_m_transfer_seconds = telemetry.histogram(
+    "device_transfer_seconds",
+    "Host->device transfer boundary seconds, by site",
+    buckets=(0.0001, 0.001, 0.01, 0.1, 1.0, 10.0))
+_m_transfer_bytes = telemetry.histogram(
+    "device_transfer_bytes",
+    "Host->device transfer boundary payload bytes, by site",
+    buckets=(1 << 10, 1 << 16, 1 << 20, 1 << 24, 1 << 28))
+_m_compiles = telemetry.counter(
+    "device_compiles", "XLA compilations observed in this process")
+_m_compile_seconds = telemetry.counter(
+    "device_compile_seconds", "XLA compilation seconds in this process")
+_g_hbm_in_use = telemetry.gauge(
+    "device_hbm_bytes_in_use", "HBM bytes in use on the first local device")
+_g_hbm_limit = telemetry.gauge(
+    "device_hbm_bytes_limit", "HBM byte capacity of the first local device")
+_g_live_arrays = telemetry.gauge(
+    "device_live_arrays", "Live jax.Array count in this process")
+_g_live_array_bytes = telemetry.gauge(
+    "device_live_array_bytes", "Live jax.Array bytes in this process")
+_g_map_mfu = telemetry.gauge(
+    "pool_map_mfu",
+    "MFU of the last device map whose device peak resolved")
+
+
+class DeviceTelemetry:
+    """Per-process device-plane aggregate; see module docstring."""
+
+    def __init__(self) -> None:
+        self.enabled = True
+        self._lock = threading.Lock()
+        # site -> [count, seconds, bytes]
+        self._transfers: Dict[str, list] = {}
+        #: Bumped on every recorded transfer/compile — workers ship a
+        #: fresh snapshot on the result stream only when this moved.
+        self.revision = 0
+        # compile observability
+        self._compiles = 0
+        self._compile_seconds = 0.0
+        self._fingerprints: Dict[str, int] = {}
+        self._recompiles: "collections.deque" = collections.deque(
+            maxlen=256)  # (mono, fingerprint)
+        self.storm_count = 4
+        self.storm_window_s = 30.0
+        self._listeners_installed = False
+        self._monitoring_available: Optional[bool] = None
+        # last live-MFU observation (None values are honest nulls)
+        self._mfu: Dict[str, Any] = {
+            "mfu": None, "flops_per_sec": None, "peak_row": None,
+            "items": None, "wall_s": None,
+        }
+        # last gauge probe (kept so snapshots are cheap + honest)
+        self._hbm: Dict[str, Optional[int]] = {
+            "bytes_in_use": None, "bytes_limit": None}
+        self._live: Dict[str, Optional[int]] = {
+            "count": None, "bytes": None}
+        # last XLA profiler capture (utils/profiling.trace notes it so
+        # trace_dump can merge the device timeline without being told)
+        self._xla_trace: Optional[Tuple[str, float, float]] = None
+
+    # -- transfer accounting -------------------------------------------
+    @contextlib.contextmanager
+    def transfer(self, site: str, nbytes: int = 0) -> Iterator[None]:
+        """Time one host→device boundary crossing. Off, the cost is one
+        attribute check; on, the observation lands in the registry
+        histograms, the flight recorder, and (when a trace context is
+        ambient — i.e. inside a traced chunk) a ``device.transfer``
+        span so the transfer shows up in the map's timeline."""
+        if not self.enabled:
+            yield
+            return
+        span_ctx = (tracing.span("device.transfer", site=site,
+                                 bytes=int(nbytes))
+                    if tracing.current() is not None
+                    else contextlib.nullcontext())
+        t0 = time.perf_counter()
+        try:
+            with span_ctx:
+                yield
+        finally:
+            self.add_transfer(site, time.perf_counter() - t0, nbytes)
+
+    def add_transfer(self, site: str, seconds: float,
+                     nbytes: int = 0) -> None:
+        """Record one completed transfer (the non-context form)."""
+        if not self.enabled:
+            return
+        nbytes = int(nbytes)
+        with self._lock:
+            agg = self._transfers.get(site)
+            if agg is None:
+                agg = self._transfers[site] = [0, 0.0, 0]
+            agg[0] += 1
+            agg[1] += seconds
+            agg[2] += nbytes
+            self.revision += 1
+        _m_transfer_seconds.observe(seconds, site=site)
+        _m_transfer_bytes.observe(float(nbytes), site=site)
+        if FLIGHT.enabled:
+            FLIGHT.record("device", "transfer", site=site,
+                          bytes=nbytes, s=round(seconds, 6))
+
+    # -- compile observability -----------------------------------------
+    def install_listeners(self) -> bool:
+        """Register the jax.monitoring compile listeners (idempotent;
+        null-safe: False when the installed jax has no monitoring
+        surface — every other signal still works). NEVER imports jax:
+        a process that hasn't loaded it (lite pool workers, host
+        agents) must not pay a multi-second interpreter tax for
+        telemetry — installation is retried from the gauge probe and
+        compile notes once jax shows up."""
+        if self._listeners_installed:
+            return True
+        if "jax" not in sys.modules:
+            return False  # deferred, not unavailable: retried later
+        if self._monitoring_available is False:
+            return False
+        from fiber_tpu.utils.jaxcompat import register_monitoring_listeners
+
+        ok = register_monitoring_listeners(self._on_jax_event,
+                                           self._on_jax_duration)
+        self._monitoring_available = ok
+        self._listeners_installed = ok
+        return ok
+
+    def _on_jax_event(self, event: str, **kwargs: Any) -> None:
+        # jax emits many event kinds; only compilation concerns us —
+        # and a compilation-CACHE hit/request is precisely not a
+        # compilation (counting it would make the healthy cached path
+        # look like a storm).
+        if "compil" not in event:
+            return
+        if "cache" in event and "miss" not in event:
+            return
+        self.note_compile(event)
+
+    def _on_jax_duration(self, event: str, duration: float,
+                         **kwargs: Any) -> None:
+        if "compil" not in event:
+            return
+        if not self.enabled:
+            return
+        with self._lock:
+            self._compile_seconds += float(duration)
+            self.revision += 1
+        _m_compile_seconds.inc(float(duration))
+
+    def note_compile(self, fingerprint: str) -> None:
+        """One compilation (or compile-cache miss) of the logical
+        program named by ``fingerprint``. The device_map plan calls this
+        on every compile-cache miss; the jax.monitoring listener calls
+        it with the event key. The same fingerprint recurring inside
+        ``storm_window_s`` is the recompile-storm signal."""
+        if not self.enabled:
+            return
+        self.install_listeners()  # a compile implies jax is loaded
+        now = time.monotonic()
+        with self._lock:
+            self._compiles += 1
+            self._fingerprints[fingerprint] = \
+                self._fingerprints.get(fingerprint, 0) + 1
+            if len(self._fingerprints) > 128:
+                # Bound the table; a storm is about repeats, not breadth.
+                self._fingerprints.pop(next(iter(self._fingerprints)))
+            self._recompiles.append((now, fingerprint))
+            self.revision += 1
+        _m_compiles.inc()
+        if FLIGHT.enabled:
+            FLIGHT.record("device", "compile",
+                          fingerprint=str(fingerprint)[:48],
+                          count=self._fingerprints.get(fingerprint, 1))
+
+    def recompile_state(self) -> Dict[str, Any]:
+        """The watchdog's per-tick probe: is any single fingerprint
+        compiling repeatedly inside the storm window?"""
+        cutoff = time.monotonic() - float(self.storm_window_s)
+        with self._lock:
+            recent: Dict[str, int] = {}
+            for mono, fp in self._recompiles:
+                if mono >= cutoff:
+                    recent[fp] = recent.get(fp, 0) + 1
+        if not recent:
+            return {"storm": False, "fingerprint": None, "count": 0}
+        fp = max(recent, key=recent.get)
+        return {"storm": recent[fp] >= int(self.storm_count),
+                "fingerprint": fp, "count": recent[fp],
+                "window_s": float(self.storm_window_s)}
+
+    # -- device gauges --------------------------------------------------
+    def update_gauges(self) -> None:
+        """Refresh HBM / live-array gauges (the monitor sampler's
+        per-tick probe). Never initializes a jax backend: with jax not
+        yet imported every field stays None — honest, not zero."""
+        if not self.enabled:
+            return
+        self.install_listeners()  # retry once jax appears (no-op else)
+        hbm = _hbm_stats()
+        live = _live_array_stats()
+        with self._lock:
+            self._hbm = hbm
+            self._live = live
+        if hbm["bytes_in_use"] is not None:
+            _g_hbm_in_use.set(float(hbm["bytes_in_use"]))
+        if hbm["bytes_limit"] is not None:
+            _g_hbm_limit.set(float(hbm["bytes_limit"]))
+        if live["count"] is not None:
+            _g_live_arrays.set(float(live["count"]))
+            _g_live_array_bytes.set(float(live["bytes"] or 0))
+
+    # -- live MFU -------------------------------------------------------
+    def note_map_flops(self, flops: float, wall_s: float,
+                       items: int) -> Optional[float]:
+        """One device map finished having executed ``flops`` analytic
+        FLOPs in ``wall_s``. When the device peak resolves
+        (utils/flops.py — real TPU kind, or FIBER_PEAK_FLOPS), the MFU
+        lands in the ``pool_map_mfu`` gauge; otherwise the observation
+        records ``mfu: None`` honestly (CPU posture). Returns the MFU
+        or None."""
+        if not self.enabled or wall_s <= 0:
+            return None
+        from fiber_tpu.utils import flops as flopsmod
+
+        value = None
+        fps = float(flops) / wall_s
+        peak = {"peak_row": None}
+        try:
+            devices = _devices()
+            if devices:
+                value = flopsmod.mfu(fps, devices)
+                peak = flopsmod.peak_report(devices)
+        except Exception:  # noqa: BLE001 - accounting must not fail maps
+            pass
+        with self._lock:
+            self._mfu = {"mfu": value, "flops_per_sec": fps,
+                         "peak_row": peak.get("peak_row"),
+                         "items": int(items), "wall_s": round(wall_s, 6)}
+            self.revision += 1
+        if value is not None:
+            _g_map_mfu.set(float(value))
+        if FLIGHT.enabled:
+            FLIGHT.record("device", "mfu", mfu=value,
+                          flops_per_sec=round(fps, 3),
+                          peak_row=peak.get("peak_row"))
+        return value
+
+    # -- unified timeline ----------------------------------------------
+    def note_xla_trace(self, log_dir: str, wall_start: float,
+                       mono_start: float) -> None:
+        """utils/profiling.trace records where the XLA profiler wrote
+        its capture (and the wall clock at trace start), so
+        ``Pool.trace_dump`` can merge the device timeline beside the
+        host spans without being told the directory."""
+        with self._lock:
+            self._xla_trace = (str(log_dir), float(wall_start),
+                               float(mono_start))
+
+    def last_xla_trace(self) -> Optional[Tuple[str, float, float]]:
+        with self._lock:
+            return self._xla_trace
+
+    # -- read side ------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Picklable per-process device-plane surface (agent
+        ``device_snapshot`` op / ``Pool.device_stats()`` / worker
+        ``("dev", …)`` frames). Null fields are honest: this process
+        has no device runtime, not 'zero bytes of HBM'."""
+        with self._lock:
+            transfers = {site: {"count": agg[0],
+                                "seconds": round(agg[1], 6),
+                                "bytes": agg[2]}
+                         for site, agg in self._transfers.items()}
+            out = {
+                "host": tracing.host_id(),
+                "pid": os.getpid(),
+                "enabled": self.enabled,
+                "revision": self.revision,
+                "transfers": transfers,
+                "transfer_bytes": sum(a[2]
+                                      for a in self._transfers.values()),
+                "transfer_seconds": round(
+                    sum(a[1] for a in self._transfers.values()), 6),
+                "compiles": self._compiles,
+                "compile_seconds": round(self._compile_seconds, 6),
+                "compile_fingerprints": dict(self._fingerprints),
+                "hbm": dict(self._hbm),
+                "live_arrays": dict(self._live),
+                "mfu": dict(self._mfu),
+            }
+        out["recompile"] = self.recompile_state()
+        out["platform"] = _platform()
+        out["jax_monitoring"] = bool(self._listeners_installed)
+        return out
+
+    def configure(self, cfg) -> None:
+        """Follow the config knobs (telemetry.refresh)."""
+        self.enabled = bool(cfg.telemetry_enabled) \
+            and bool(cfg.device_telemetry_enabled)
+        self.storm_count = max(2, int(cfg.anomaly_recompile_count))
+        self.storm_window_s = max(1.0,
+                                  float(cfg.anomaly_recompile_window_s))
+        if self.enabled:
+            self.install_listeners()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._transfers.clear()
+            self._compiles = 0
+            self._compile_seconds = 0.0
+            self._fingerprints.clear()
+            self._recompiles.clear()
+            self.revision = 0
+            self._mfu = {"mfu": None, "flops_per_sec": None,
+                         "peak_row": None, "items": None, "wall_s": None}
+            self._hbm = {"bytes_in_use": None, "bytes_limit": None}
+            self._live = {"count": None, "bytes": None}
+            self._xla_trace = None
+
+
+# ---------------------------------------------------------------------------
+# Null-safe device probes (never initialize a backend, never raise)
+# ---------------------------------------------------------------------------
+
+
+def _devices():
+    """Local jax devices, or None when jax was never imported here —
+    probing must not pay (or trigger) a backend initialization in a
+    process that does no device work (host agents, lite workers)."""
+    if "jax" not in sys.modules:
+        return None
+    try:
+        import jax
+
+        return jax.local_devices()
+    except Exception:  # noqa: BLE001 - no backend is a valid state
+        return None
+
+
+def _platform() -> Optional[str]:
+    devices = _devices()
+    if not devices:
+        return None
+    return getattr(devices[0], "platform", None)
+
+
+def _hbm_stats() -> Dict[str, Optional[int]]:
+    """First-local-device memory stats: ``{"bytes_in_use", "bytes_limit"}``,
+    both None when unavailable (CPU backends return None or an empty
+    dict from ``memory_stats()``; older jaxlib lacks the method)."""
+    devices = _devices()
+    if not devices:
+        return {"bytes_in_use": None, "bytes_limit": None}
+    try:
+        stats = getattr(devices[0], "memory_stats", lambda: None)()
+    except Exception:  # noqa: BLE001 - platform-dependent surface
+        stats = None
+    if not stats:
+        return {"bytes_in_use": None, "bytes_limit": None}
+    return {
+        "bytes_in_use": _maybe_int(stats.get("bytes_in_use")),
+        "bytes_limit": _maybe_int(stats.get("bytes_limit")
+                                  or stats.get("bytes_reservable_limit")),
+    }
+
+
+def _maybe_int(value) -> Optional[int]:
+    try:
+        return int(value) if value is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
+def _live_array_stats() -> Dict[str, Optional[int]]:
+    if "jax" not in sys.modules:
+        return {"count": None, "bytes": None}
+    try:
+        import jax
+
+        arrays = jax.live_arrays()
+        total = 0
+        for arr in arrays:
+            try:
+                total += int(arr.nbytes)
+            except Exception:  # noqa: BLE001 - deleted/donated buffers
+                continue
+        return {"count": len(arrays), "bytes": total}
+    except Exception:  # noqa: BLE001
+        return {"count": None, "bytes": None}
+
+
+#: Process-wide device telemetry (knobs follow ``device_telemetry_*``
+#: via telemetry.refresh()).
+DEVICE = DeviceTelemetry()
+
+
+def transfer(site: str, nbytes: int = 0):
+    """Module-level convenience: ``with device.transfer("dmap", n): …``"""
+    return DEVICE.transfer(site, nbytes)
+
+
+def snapshot() -> Dict[str, Any]:
+    return DEVICE.snapshot()

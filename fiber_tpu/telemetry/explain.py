@@ -42,7 +42,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 #: Blame categories, ranked in the verdict (compute is context, not
 #: blame — it appears in the budget but never as primary unless nothing
 #: else has weight).
-CATEGORIES = ("straggler", "store_fetch", "locality_miss",
+CATEGORIES = ("straggler", "transfer", "store_fetch", "locality_miss",
               "backpressure", "transport_stall")
 
 
@@ -177,6 +177,33 @@ def explain_trace(spans: Sequence[Dict[str, Any]],
         "source": dur_source,
     }
 
+    # Transfer: seconds spent crossing the host->device boundary
+    # (device telemetry plane — ``device``/``transfer`` flight events;
+    # ``device.transfer`` spans are the fallback for artifacts recorded
+    # without the flight recorder). The transferred bytes are the
+    # evidence: a verdict naming transfer should say HOW MUCH crossed.
+    xfer_events = [ev for ev in scoped
+                   if ev.get("plane") == "device"
+                   and ev.get("kind") == "transfer"]
+    xfer_source = "device.transfer events"
+    if xfer_events:
+        budget["transfer"] = sum(float(ev.get("s", 0.0))
+                                 for ev in xfer_events)
+        xfer_bytes = sum(int(ev.get("bytes", 0)) for ev in xfer_events)
+        xfer_count = len(xfer_events)
+    else:
+        xfer_spans = [sp for sp in mine
+                      if sp.get("name") == "device.transfer"]
+        budget["transfer"] = sum(float(sp.get("dur", 0.0))
+                                 for sp in xfer_spans)
+        xfer_bytes = sum(int(sp.get("bytes", 0)) for sp in xfer_spans)
+        xfer_count = len(xfer_spans)
+        xfer_source = "device.transfer spans"
+    evidence["transfer"] = {
+        "transfers": xfer_count, "bytes": xfer_bytes,
+        "source": xfer_source,
+    }
+
     wire_fetches = [ev for ev in scoped
                     if ev.get("plane") == "store"
                     and ev.get("kind") == "fetch" and ev.get("wire")]
@@ -242,6 +269,11 @@ def render(verdict: Dict[str, Any]) -> str:
             f"straggler evidence: {ev['outliers']}/{ev['chunks']} outlier "
             f"chunk(s) vs median {ev['median_s']:.4f}s, "
             f"{ev['speculations']} speculation(s) [{ev['source']}]")
+    ev = verdict.get("evidence", {}).get("transfer")
+    if ev and verdict.get("primary") == "transfer":
+        lines.append(
+            f"transfer evidence: {ev['transfers']} host->device "
+            f"transfer(s), {ev['bytes']} bytes [{ev['source']}]")
     frames = verdict.get("evidence", {}).get("compute_frames")
     if frames and verdict.get("primary") == "compute":
         lines.append("compute is the verdict — top sampled frames:")

@@ -65,10 +65,118 @@ def chrome_trace(spans: List[Dict]) -> Dict:
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
-def write_chrome_trace(path: str, spans: List[Dict]) -> str:
+def write_chrome_trace(path: str, spans: List[Dict],
+                       xla_dir: Optional[str] = None,
+                       xla_wall_start: Optional[float] = None) -> str:
+    """Write spans as Chrome trace JSON; with ``xla_dir`` the newest
+    XLA profiler capture under it (``jax.profiler.trace`` output) is
+    merged in so device ops render beside the host spans — the unified
+    timeline (docs/observability.md "Device telemetry")."""
+    doc = chrome_trace(spans)
+    if xla_dir:
+        merge_xla_trace(doc, xla_dir, wall_start=xla_wall_start)
     with open(path, "w") as fh:
-        json.dump(chrome_trace(spans), fh)
+        json.dump(doc, fh)
     return path
+
+
+# ---------------------------------------------------------------------------
+# Unified host+device timeline: merge an XLA profiler capture
+# ---------------------------------------------------------------------------
+
+
+def find_xla_chrome_trace(log_dir: str) -> Optional[str]:
+    """Newest ``*.trace.json[.gz]`` under a ``jax.profiler.trace`` log
+    directory (the profiler writes Chrome trace-event JSON beside its
+    TensorBoard protos, under ``plugins/profile/<run>/``), or None."""
+    newest: Optional[str] = None
+    newest_mtime = -1.0
+    for root, _dirs, files in os.walk(log_dir):
+        for name in files:
+            if not (name.endswith(".trace.json.gz")
+                    or name.endswith(".trace.json")):
+                continue
+            path = os.path.join(root, name)
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                continue
+            if mtime > newest_mtime:
+                newest, newest_mtime = path, mtime
+    return newest
+
+
+def load_xla_chrome_trace(path: str) -> Optional[Dict]:
+    """Parse one XLA Chrome trace file (plain or gzipped); None when the
+    file is unreadable or not a trace (merging is best-effort — a
+    missing device capture must never fail a host trace dump)."""
+    import gzip
+
+    try:
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rt") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        return None
+    return doc
+
+
+def merge_xla_trace(doc: Dict, log_dir: str,
+                    wall_start: Optional[float] = None) -> int:
+    """Merge the newest XLA capture under ``log_dir`` into a host
+    Chrome-trace ``doc`` (chrome_trace output), in place. Host spans
+    carry wall-epoch timestamps; XLA events carry the profiler's own
+    µs origin — with ``wall_start`` (the wall clock when the capture
+    began, noted by ``utils.profiling.trace``) the device events are
+    rebased onto the wall axis so both planes line up on the dual
+    clock; without it they are rebased to the host trace's start.
+    Device pids are offset past the host rows (and their process_name
+    metadata prefixed ``XLA``) so Perfetto renders separate device
+    lanes. Returns the number of device events merged (0 = no capture
+    found; never raises)."""
+    try:
+        path = find_xla_chrome_trace(log_dir)
+        if path is None:
+            return 0
+        xla = load_xla_chrome_trace(path)
+        if xla is None:
+            return 0
+        host_events = doc.setdefault("traceEvents", [])
+        pid_base = max((int(e.get("pid", 0)) for e in host_events),
+                       default=0) + 1000
+        xla_events = xla.get("traceEvents", [])
+        timed = [float(e["ts"]) for e in xla_events if "ts" in e]
+        xla_t0 = min(timed) if timed else 0.0
+        if wall_start is None:
+            wall_start = min(
+                (float(e["ts"]) / 1e6 for e in host_events
+                 if e.get("ph") == "X"), default=0.0)
+        offset_us = float(wall_start) * 1e6 - xla_t0
+        merged = 0
+        for ev in xla_events:
+            if "ph" not in ev:
+                # Chrome trace arrays may end with a bare {} (and some
+                # producers emit phase-less entries); a merged artifact
+                # must stay iterable by strict consumers.
+                continue
+            ev = dict(ev)
+            if "pid" in ev:
+                ev["pid"] = int(ev["pid"]) + pid_base
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                args = dict(ev.get("args") or {})
+                args["name"] = f"XLA {args.get('name', 'device')}"
+                ev["args"] = args
+            if "ts" in ev:
+                ev["ts"] = float(ev["ts"]) + offset_us
+            host_events.append(ev)
+            merged += 1
+        return merged
+    except Exception:  # noqa: BLE001 - merging is strictly best-effort
+        logger.exception("telemetry: XLA trace merge failed; "
+                         "writing host spans only")
+        return 0
 
 
 # ---------------------------------------------------------------------------

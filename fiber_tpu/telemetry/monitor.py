@@ -67,6 +67,7 @@ class AnomalyWatchdog:
         self.tx_queue_bytes = 16 << 20
         self.disk_fill_pct = 0.9
         self.suspect_timeout = 10.0
+        self.hbm_fill_pct = 0.92
         # state
         self._rates: Deque[float] = collections.deque(
             maxlen=TREND_WINDOW + 1)
@@ -84,6 +85,8 @@ class AnomalyWatchdog:
         self.disk_fill_pct = min(1.0, max(0.05,
                                           float(cfg.anomaly_disk_fill_pct)))
         self.suspect_timeout = float(cfg.suspect_timeout or 0.0)
+        self.hbm_fill_pct = min(1.0, max(0.05,
+                                         float(cfg.anomaly_hbm_fill_pct)))
 
     # -- breach bookkeeping --------------------------------------------
     def _raise_anomaly(self, rule: str, detail: str,
@@ -186,6 +189,27 @@ class AnomalyWatchdog:
                     "draining"),
             bytes=int(txq))
 
+        # 6. HBM fill (device telemetry plane; both fields None on CPU
+        # or when no device runtime exists — honest null, no breach)
+        used, limit = _hbm_usage()
+        self._edge(
+            "hbm_fill",
+            limit > 0 and used > self.hbm_fill_pct * limit,
+            detail=(f"HBM {used >> 20}MB > "
+                    f"{self.hbm_fill_pct:.0%} of {limit >> 20}MB"),
+            bytes=used, limit=limit)
+
+        # 7. recompile storm: one fingerprint compiling repeatedly
+        # inside the device plane's window — shape churn, not progress
+        storm = _recompile_state()
+        self._edge(
+            "recompile_storm", bool(storm.get("storm")),
+            detail=(f"{storm.get('count', 0)} recompiles of "
+                    f"{str(storm.get('fingerprint'))[:48]!r} within "
+                    f"{storm.get('window_s', 0)}s"),
+            fingerprint=str(storm.get("fingerprint"))[:48],
+            count=int(storm.get("count", 0)))
+
     # -- read side -----------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
@@ -203,6 +227,32 @@ class AnomalyWatchdog:
             self._rates.clear()
             self._queue_depths.clear()
             self.total = 0
+
+
+def _hbm_usage() -> "tuple[int, int]":
+    """(bytes in use, limit) of the first local device's HBM; (0, 0)
+    when unavailable (CPU, no device runtime) — the rule can't breach
+    without a real limit."""
+    try:
+        from fiber_tpu.telemetry.device import DEVICE
+
+        with DEVICE._lock:
+            hbm = dict(DEVICE._hbm)
+        return int(hbm.get("bytes_in_use") or 0), \
+            int(hbm.get("bytes_limit") or 0)
+    except Exception:  # noqa: BLE001 - monitoring must not fail
+        return 0, 0
+
+
+def _recompile_state() -> Dict[str, Any]:
+    """The device plane's recompile-storm probe (monkeypatchable in
+    tests, like _store_disk_usage)."""
+    try:
+        from fiber_tpu.telemetry.device import DEVICE
+
+        return DEVICE.recompile_state()
+    except Exception:  # noqa: BLE001 - monitoring must not fail
+        return {"storm": False}
 
 
 def _store_disk_usage() -> "tuple[int, int]":
@@ -247,4 +297,24 @@ def monitor_payload(history: int = 120) -> Dict[str, Any]:
         "timeseries": TIMESERIES.snapshot(last=int(history)),
         "anomalies": WATCHDOG.snapshot(),
         "heartbeat_ages": ages,
+        "device": _device_summary(),
     }
+
+
+def _device_summary() -> Dict[str, Any]:
+    """Compact device-plane row for `fiber-tpu top` (HBM + MFU
+    columns): None fields are honest nulls, never zeros — the table
+    renders them as '-' (docs/observability.md "Device telemetry")."""
+    try:
+        from fiber_tpu.telemetry.device import DEVICE
+
+        snap = DEVICE.snapshot()
+        return {
+            "hbm_bytes_in_use": snap["hbm"].get("bytes_in_use"),
+            "hbm_bytes_limit": snap["hbm"].get("bytes_limit"),
+            "mfu": snap["mfu"].get("mfu"),
+            "compiles": snap.get("compiles", 0),
+            "transfer_bytes": snap.get("transfer_bytes", 0),
+        }
+    except Exception:  # noqa: BLE001 - monitoring must not fail
+        return {}

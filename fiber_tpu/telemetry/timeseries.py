@@ -52,6 +52,12 @@ TRACKED_GAUGES = {
     "queue_depth": "pool_queue_depth",
     "inflight": "pool_inflight_tasks",
     "tx_queue_bytes": "transport_evloop_tx_queue_bytes",
+    # Device telemetry plane (docs/observability.md "Device telemetry"):
+    # gauges only ever set when a device runtime reports them (CPU and
+    # agent processes leave them unset -> 0 in the series; the honest
+    # null lives in device_snapshot / the hbm_fill rule's probe).
+    "hbm_bytes_in_use": "device_hbm_bytes_in_use",
+    "live_array_bytes": "device_live_array_bytes",
 }
 #: Counter series whose per-second rate rides the sample dict (the
 #: ``fiber-tpu top`` columns).

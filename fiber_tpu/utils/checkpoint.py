@@ -78,17 +78,25 @@ def save(path: str, tree: Any) -> None:
 
 def load(path: str, device_put: bool = False) -> Any:
     """Load a pytree saved by :func:`save`. With ``device_put=True`` the
-    leaves are placed on the default device."""
+    leaves are placed on the default device — as ONE batched tree
+    transfer (``jax.device_put`` over the whole leaf list dispatches a
+    single transfer program) instead of a per-leaf loop that paid a
+    dispatch + synchronization per array, routed through the device
+    telemetry plane's transfer accounting (docs/observability.md)."""
     import numpy as np
 
     with np.load(path, allow_pickle=False) as data:
         skeleton = json.loads(data["__structure__"].tobytes().decode())
         n = len([k for k in data.files if k.startswith("leaf_")])
         leaves = [data[f"leaf_{i}"] for i in range(n)]
-    if device_put:
+    if device_put and leaves:
         import jax
 
-        leaves = [jax.device_put(leaf) for leaf in leaves]
+        from fiber_tpu.telemetry.device import DEVICE
+
+        total = sum(int(getattr(leaf, "nbytes", 0)) for leaf in leaves)
+        with DEVICE.transfer("checkpoint", total):
+            leaves = jax.device_put(leaves)
     return _decode(skeleton, leaves)
 
 

@@ -39,3 +39,31 @@ def axis_size(axis_name):
     if hasattr(jax.lax, "axis_size"):
         return int(jax.lax.axis_size(axis_name))
     return int(jax.lax.psum(1, axis_name))
+
+
+def register_monitoring_listeners(on_event, on_duration) -> bool:
+    """Null-safe shim over ``jax.monitoring``: register ``on_event``
+    (called with the event key) and ``on_duration`` (event key +
+    seconds) for jax-internal events — compilation being the one the
+    device telemetry plane cares about. Returns False when the
+    installed jax predates the monitoring surface (or exposes neither
+    listener hook): callers degrade gracefully, recording nothing
+    rather than raising (docs/observability.md "Device telemetry")."""
+    try:
+        from jax import monitoring
+    except ImportError:
+        return False
+    reg_event = getattr(monitoring, "register_event_listener", None)
+    reg_duration = getattr(
+        monitoring, "register_event_duration_secs_listener",
+        getattr(monitoring, "register_event_duration_listener", None))
+    if reg_event is None and reg_duration is None:
+        return False
+    try:
+        if reg_event is not None:
+            reg_event(on_event)
+        if reg_duration is not None:
+            reg_duration(on_duration)
+    except Exception:  # noqa: BLE001 - a broken hook must not crash init
+        return False
+    return True

@@ -31,14 +31,25 @@ from typing import Dict, Iterator, Optional
 @contextlib.contextmanager
 def trace(log_dir: str) -> Iterator[None]:
     """Capture an XLA/host trace of the enclosed region into ``log_dir``
-    (view with TensorBoard's profile plugin)."""
+    (view with TensorBoard's profile plugin). The capture location and
+    the wall clock at trace start are noted with the device telemetry
+    plane, so a later ``Pool.trace_dump`` merges the XLA device
+    timeline beside the host spans on the dual clock
+    (docs/observability.md "Unified timeline")."""
     import jax
 
+    wall0, mono0 = time.time(), time.monotonic()
     jax.profiler.start_trace(log_dir)
     try:
         yield
     finally:
         jax.profiler.stop_trace()
+        try:
+            from fiber_tpu.telemetry.device import DEVICE
+
+            DEVICE.note_xla_trace(log_dir, wall0, mono0)
+        except Exception:  # noqa: BLE001 - accounting must not fail traces
+            pass
 
 
 @contextlib.contextmanager

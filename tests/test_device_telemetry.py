@@ -1,0 +1,584 @@
+"""Device telemetry plane: transfer accounting, compile observability +
+recompile-storm watchdog, device gauges, unified host+device timeline,
+live MFU, and the `fiber-tpu devices` / `top` surfaces
+(docs/observability.md "Device telemetry")."""
+
+import gzip
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import fiber_tpu
+from fiber_tpu import config, telemetry
+from fiber_tpu.telemetry import monitor as monitormod
+from fiber_tpu.telemetry import tracing
+from fiber_tpu.telemetry.device import DEVICE
+from fiber_tpu.telemetry.flightrec import FLIGHT
+from fiber_tpu.telemetry.monitor import WATCHDOG, AnomalyWatchdog
+from tests import targets
+
+
+@pytest.fixture(autouse=True)
+def _device_isolation():
+    """Each test starts with clean device-plane state and ends with
+    config overrides dropped (init re-syncs the plane)."""
+    DEVICE.clear()
+    WATCHDOG.clear()
+    FLIGHT.clear()
+    yield
+    fiber_tpu.init()
+    DEVICE.clear()
+    WATCHDOG.clear()
+
+
+def _sample(**kw):
+    base = {"wall": time.time(), "mono": time.monotonic(),
+            "tasks_per_s": 0.0, "inflight": 0.0, "queue_depth": 0.0,
+            "heartbeat_age_s": 0.0, "tx_queue_bytes": 0.0}
+    base.update(kw)
+    return base
+
+
+# ---------------------------------------------------------------------------
+# transfer accounting
+# ---------------------------------------------------------------------------
+
+
+def test_transfer_records_metrics_flight_and_span():
+    fiber_tpu.init()
+    before = telemetry.histogram("device_transfer_seconds").count(
+        site="unit")
+    with tracing.trace_context("t-dev", None):
+        with DEVICE.transfer("unit", 4096):
+            time.sleep(0.005)
+    snap = DEVICE.snapshot()
+    agg = snap["transfers"]["unit"]
+    assert agg["count"] == 1 and agg["bytes"] == 4096
+    assert agg["seconds"] >= 0.004
+    assert snap["transfer_bytes"] == 4096
+    assert telemetry.histogram("device_transfer_seconds").count(
+        site="unit") == before + 1
+    assert telemetry.histogram("device_transfer_bytes").sum(
+        site="unit") >= 4096
+    # flight event on the device plane
+    ev = [e for e in FLIGHT.snapshot()
+          if e["plane"] == "device" and e["kind"] == "transfer"]
+    assert ev and ev[-1]["site"] == "unit" and ev[-1]["bytes"] == 4096
+    # span joined the ambient trace (explain's fallback source)
+    sp = [s for s in tracing.SPANS.snapshot()
+          if s["name"] == "device.transfer"]
+    assert sp and sp[-1]["trace"] == "t-dev" and sp[-1]["bytes"] == 4096
+
+
+def test_transfer_off_is_noop():
+    fiber_tpu.init(device_telemetry_enabled=False)
+    assert not DEVICE.enabled
+    with DEVICE.transfer("unit", 100):
+        pass
+    DEVICE.note_compile("fp")
+    assert DEVICE.snapshot()["transfers"] == {}
+    assert DEVICE.snapshot()["compiles"] == 0
+    # the telemetry master switch kills the plane too
+    fiber_tpu.init(telemetry_enabled=False)
+    assert not DEVICE.enabled
+
+
+def test_transfer_counters_through_real_map_with_store_broadcast():
+    """The acceptance path: a broadcast arg big enough to travel by
+    reference is resolved once per worker through the store — that
+    resolution is a host->device boundary, accounted per worker and
+    shipped to the master on the result stream (("dev", ...) frames),
+    where Pool.device_stats() renders it beside the master's own and
+    the backend's per-host snapshots."""
+    fiber_tpu.init(worker_lite=True, store_inline_max=64 * 1024)
+    arr = np.ones((200_000,), dtype=np.float64)  # 1.6MB > inline max
+    with fiber_tpu.Pool(2) as pool:
+        out = pool.starmap(targets.arr_sum_plus,
+                           [(arr, i) for i in range(8)], chunksize=1)
+        assert out == [float(arr.sum()) + i for i in range(8)]
+        stats = pool.device_stats()
+    assert set(stats) >= {"master", "workers", "hosts"}
+    assert stats["hosts"].keys() == {"local"}
+    assert stats["workers"], "no worker shipped device frames"
+    for snap in stats["workers"].values():
+        agg = snap["transfers"]["store_resolve"]
+        assert agg["bytes"] >= arr.nbytes
+        assert agg["seconds"] > 0
+        assert agg["count"] >= 1
+        # null-safe on CPU: HBM is honestly None, never zero/raise
+        assert snap["hbm"]["bytes_in_use"] is None
+        assert snap["hbm"]["bytes_limit"] is None
+        assert snap["compiles"] >= 0
+
+
+def test_checkpoint_load_batches_device_put_through_accounting(tmp_path):
+    """Satellite: load(device_put=True) transfers the whole leaf list
+    as ONE batched tree transfer, routed through the `checkpoint`
+    transfer site."""
+    import jax
+
+    from fiber_tpu.utils import checkpoint
+
+    fiber_tpu.init()
+    tree = {"w": np.arange(1024.0), "b": [np.ones(8), np.zeros(4)]}
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint.save(path, tree)
+    restored = checkpoint.load(path, device_put=True)
+    assert isinstance(restored["w"], jax.Array)
+    assert np.allclose(np.asarray(restored["w"]), tree["w"])
+    assert np.allclose(np.asarray(restored["b"][0]), tree["b"][0])
+    agg = DEVICE.snapshot()["transfers"]["checkpoint"]
+    assert agg["count"] == 1  # one batched transfer, not one per leaf
+    expected = sum(leaf.nbytes
+                   for leaf in (tree["w"], tree["b"][0], tree["b"][1]))
+    assert agg["bytes"] == expected
+
+
+def test_dmap_transfer_accounted_and_fingerprinted():
+    from fiber_tpu.parallel import device_map
+
+    fiber_tpu.init()
+
+    def triple(x):
+        return x * 3
+
+    out = device_map(triple, np.arange(16.0))
+    assert float(out[5]) == 15.0
+    snap = DEVICE.snapshot()
+    assert snap["transfers"]["dmap"]["count"] >= 1
+    assert snap["transfers"]["dmap"]["bytes"] >= 16 * 8
+    assert any("triple" in fp for fp in snap["compile_fingerprints"])
+    # cached second call: no new fingerprint note
+    before = snap["compiles"]
+    device_map(triple, np.arange(16.0))
+    ours = {fp: n for fp, n in
+            DEVICE.snapshot()["compile_fingerprints"].items()
+            if "triple" in fp}
+    assert sum(ours.values()) == 1, \
+        f"cache hit re-fingerprinted: {ours} (compiles {before})"
+
+
+# ---------------------------------------------------------------------------
+# compile observability + recompile storm
+# ---------------------------------------------------------------------------
+
+
+def test_monitoring_listener_shim_is_null_safe(monkeypatch):
+    """Older jax without jax.monitoring (or with the hooks missing):
+    registration reports False and nothing raises — every other
+    device-plane signal keeps working."""
+    from fiber_tpu.utils import jaxcompat
+
+    monitoring = pytest.importorskip("jax").monitoring
+    monkeypatch.delattr(monitoring, "register_event_listener",
+                        raising=False)
+    monkeypatch.delattr(monitoring,
+                        "register_event_duration_secs_listener",
+                        raising=False)
+    monkeypatch.delattr(monitoring, "register_event_duration_listener",
+                        raising=False)
+    assert jaxcompat.register_monitoring_listeners(
+        lambda *a, **k: None, lambda *a, **k: None) is False
+    from fiber_tpu.telemetry.device import DeviceTelemetry
+
+    fresh = DeviceTelemetry()
+    assert fresh.install_listeners() is False
+    # and a compile-accounting call still works without the listeners
+    fresh.note_compile("fp")
+    assert fresh.snapshot()["compiles"] == 1
+
+
+def test_jax_event_listener_counts_compiles_not_cache_hits():
+    fiber_tpu.init()
+    DEVICE._on_jax_event("/jax/compilation_cache/tasks_using_cache")
+    DEVICE._on_jax_event("/jax/compilation_cache/cache_hits")
+    assert DEVICE.snapshot()["compiles"] == 0
+    DEVICE._on_jax_event("/jax/compilation_cache/cache_misses")
+    DEVICE._on_jax_duration("backend_compile", 0.25)
+    DEVICE._on_jax_duration("/jax/unrelated/event", 9.0)
+    snap = DEVICE.snapshot()
+    assert snap["compiles"] == 1
+    assert snap["compile_seconds"] == pytest.approx(0.25)
+
+
+def test_recompile_storm_synthetic_trigger_and_watchdog_edge_clear():
+    """Satellite: the same fingerprint compiling repeatedly inside the
+    window is a storm; the watchdog raises `recompile_storm` ONCE
+    (edge), keeps it active while the storm persists, and clears when
+    the window drains."""
+    fiber_tpu.init(anomaly_recompile_count=3,
+                   anomaly_recompile_window_s=30.0)
+    dog = AnomalyWatchdog()
+    dog.configure(config.get())
+    assert DEVICE.storm_count == 3
+    DEVICE.note_compile("shape-churn")
+    DEVICE.note_compile("shape-churn")
+    assert DEVICE.recompile_state()["storm"] is False
+    dog.observe(_sample())
+    assert "recompile_storm" not in dog.snapshot()["active"]
+    DEVICE.note_compile("shape-churn")
+    state = DEVICE.recompile_state()
+    assert state["storm"] is True and state["count"] == 3
+    assert state["fingerprint"] == "shape-churn"
+    dog.observe(_sample())
+    snap = dog.snapshot()
+    assert "recompile_storm" in snap["active"]
+    assert snap["active"]["recompile_storm"]["count"] == 3
+    total = snap["total"]
+    dog.observe(_sample())          # same incident: no second event
+    assert dog.snapshot()["total"] == total
+    # flight + registry evidence
+    kinds = {(e["plane"], e["kind"]) for e in FLIGHT.snapshot()}
+    assert ("monitor", "recompile_storm") in kinds
+    # the window drains -> clear edge
+    DEVICE._recompiles.clear()
+    dog.observe(_sample())
+    assert "recompile_storm" not in dog.snapshot()["active"]
+    kinds = [(e["kind"], e.get("rule")) for e in FLIGHT.snapshot()
+             if e["plane"] == "monitor"]
+    assert ("clear", "recompile_storm") in kinds
+
+
+def test_hbm_fill_rule(monkeypatch):
+    fiber_tpu.init(anomaly_hbm_fill_pct=0.9)
+    dog = AnomalyWatchdog()
+    dog.configure(config.get())
+    monkeypatch.setattr(monitormod, "_hbm_usage",
+                        lambda: (95 << 20, 100 << 20))
+    dog.observe(_sample())
+    assert "hbm_fill" in dog.snapshot()["active"]
+    monkeypatch.setattr(monitormod, "_hbm_usage",
+                        lambda: (10 << 20, 100 << 20))
+    dog.observe(_sample())
+    assert dog.snapshot()["active"] == {}
+    # CPU posture: no limit -> the rule can never breach
+    monkeypatch.setattr(monitormod, "_hbm_usage", lambda: (0, 0))
+    dog.observe(_sample())
+    assert "hbm_fill" not in dog.snapshot()["active"]
+
+
+def test_device_gauges_ride_monitor_sampler():
+    from fiber_tpu.telemetry.timeseries import TIMESERIES
+
+    fiber_tpu.init(monitor_enabled=False)  # drive ticks by hand
+    TIMESERIES.clear()
+    try:
+        TIMESERIES.add_probe(DEVICE.update_gauges)
+        TIMESERIES.sample_once()
+        series = TIMESERIES.snapshot()["series"]
+        # device gauges are tracked series (CPU leaves them unset -> 0;
+        # the honest None lives in device_snapshot)
+        assert "hbm_bytes_in_use" in series
+        assert "live_array_bytes" in series
+    finally:
+        TIMESERIES.clear()
+
+
+# ---------------------------------------------------------------------------
+# null-safe snapshots
+# ---------------------------------------------------------------------------
+
+
+def test_device_snapshot_null_safe_on_cpu():
+    fiber_tpu.init()
+    DEVICE.update_gauges()
+    snap = DEVICE.snapshot()
+    assert snap["hbm"] == {"bytes_in_use": None, "bytes_limit": None}
+    assert snap["mfu"]["mfu"] is None
+    # live arrays ARE countable on CPU jax (it's a process property)
+    assert snap["live_arrays"]["count"] is None \
+        or snap["live_arrays"]["count"] >= 0
+    json.dumps(snap)  # picklable/JSON-able agent payload
+
+
+def test_hbm_probe_survives_broken_memory_stats(monkeypatch):
+    from fiber_tpu.telemetry import device as devmod
+
+    class _Dev:
+        platform = "tpu"
+
+        def memory_stats(self):
+            raise RuntimeError("PJRT says no")
+
+    monkeypatch.setattr(devmod, "_devices", lambda: [_Dev()])
+    assert devmod._hbm_stats() == {"bytes_in_use": None,
+                                   "bytes_limit": None}
+    # and a device that DOES report stats surfaces them
+    class _Good:
+        platform = "tpu"
+
+        def memory_stats(self):
+            return {"bytes_in_use": 10, "bytes_limit": 100}
+
+    monkeypatch.setattr(devmod, "_devices", lambda: [_Good()])
+    assert devmod._hbm_stats() == {"bytes_in_use": 10,
+                                   "bytes_limit": 100}
+
+
+# ---------------------------------------------------------------------------
+# live MFU
+# ---------------------------------------------------------------------------
+
+
+def test_live_mfu_gauge_when_peak_resolves(monkeypatch):
+    fiber_tpu.init()
+
+    @fiber_tpu.meta(device=True, flops=1000.0)
+    def sq(x):
+        return x * x
+
+    # no peak (CPU): the observation records None honestly
+    with fiber_tpu.Pool(2) as pool:
+        out = pool.map(sq, np.arange(8.0))
+        assert [float(v) for v in out] == [x * x for x in range(8)]
+    mfu = DEVICE.snapshot()["mfu"]
+    assert mfu["mfu"] is None
+    assert mfu["items"] == 8
+    assert mfu["flops_per_sec"] > 0
+    # a resolved peak (FIBER_PEAK_FLOPS, the bench-cluster override)
+    # populates the gauge
+    monkeypatch.setenv("FIBER_PEAK_FLOPS", "1e12")
+    with fiber_tpu.Pool(2) as pool:
+        pool.map(sq, np.arange(8.0))
+    mfu = DEVICE.snapshot()["mfu"]
+    assert mfu["mfu"] is not None and 0 < mfu["mfu"] < 1
+    assert mfu["peak_row"] == "env:1e+12"
+    assert telemetry.gauge("pool_map_mfu").value() == \
+        pytest.approx(mfu["mfu"])
+    kinds = {(e["plane"], e["kind"]) for e in FLIGHT.snapshot()}
+    assert ("device", "mfu") in kinds
+
+
+# ---------------------------------------------------------------------------
+# unified host+device timeline
+# ---------------------------------------------------------------------------
+
+
+def _write_fake_xla_capture(root) -> str:
+    """A capture shaped like jax.profiler.trace output: Chrome trace
+    JSON gzipped under plugins/profile/<run>/."""
+    run = os.path.join(str(root), "plugins", "profile", "run1")
+    os.makedirs(run)
+    doc = {"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "name": "fusion.1", "pid": 1, "tid": 1,
+         "ts": 100.0, "dur": 50.0},
+        {"ph": "X", "name": "copy.2", "pid": 1, "tid": 1,
+         "ts": 200.0, "dur": 10.0},
+    ]}
+    with gzip.open(os.path.join(run, "host.trace.json.gz"), "wt") as fh:
+        json.dump(doc, fh)
+    return str(root)
+
+
+def test_trace_dump_merges_xla_capture(tmp_path):
+    """The unified timeline: trace_dump writes ONE valid Chrome trace
+    holding host spans AND the XLA capture's device ops, rebased onto
+    the wall axis and on distinct process rows."""
+    fiber_tpu.init(worker_lite=True)
+    xla_dir = _write_fake_xla_capture(tmp_path / "xla")
+    with fiber_tpu.Pool(2) as pool:
+        xs = list(range(8))
+        assert pool.map(targets.sleep_echo, xs, chunksize=2) == xs
+        out = pool.trace_dump(str(tmp_path / "merged.json"),
+                              xla_dir=xla_dir)
+    with open(out) as fh:
+        doc = json.load(fh)
+    names = {e.get("name") for e in doc["traceEvents"]}
+    assert "worker.execute" in names          # host plane
+    assert "fusion.1" in names                # device plane
+    host_ev = next(e for e in doc["traceEvents"]
+                   if e.get("name") == "worker.execute")
+    dev_ev = next(e for e in doc["traceEvents"]
+                  if e.get("name") == "fusion.1")
+    # device events rebased onto the host wall axis (same epoch scale)
+    assert abs(dev_ev["ts"] - host_ev["ts"]) < 600 * 1e6
+    assert dev_ev["pid"] != host_ev["pid"]    # separate lanes
+    metas = [e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "process_name"]
+    assert any(m.startswith("XLA ") for m in metas)
+
+
+def test_trace_dump_uses_noted_capture_and_survives_missing(tmp_path):
+    fiber_tpu.init()
+    with tracing.span("pool.serialize", trace="t9", seq=9):
+        pass
+    # a noted capture directory with NO trace files: merge is a no-op,
+    # the host dump still writes
+    DEVICE.note_xla_trace(str(tmp_path / "empty"), time.time(),
+                          time.monotonic())
+    from fiber_tpu.telemetry import export
+
+    out = export.write_chrome_trace(
+        str(tmp_path / "host_only.json"), tracing.SPANS.snapshot(),
+        xla_dir=str(tmp_path / "empty"))
+    with open(out) as fh:
+        doc = json.load(fh)
+    assert any(e.get("name") == "pool.serialize"
+               for e in doc["traceEvents"])
+    # and with a real capture, the noted dir merges without being told
+    xla_dir = _write_fake_xla_capture(tmp_path / "xla2")
+    assert export.merge_xla_trace(doc, xla_dir,
+                                  wall_start=time.time()) == 3
+
+
+# ---------------------------------------------------------------------------
+# collection plane: agent op, backends, CLI
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def embedded_agent(tmp_path):
+    from fiber_tpu.host_agent import HostAgent
+
+    agent = HostAgent(0, bind="127.0.0.1", staging_root=str(tmp_path))
+    t = threading.Thread(target=agent.serve_forever, daemon=True)
+    t.start()
+    yield agent
+    agent.stop()
+
+
+def test_agent_device_snapshot_op(embedded_agent):
+    from fiber_tpu.backends.tpu import AgentClient
+
+    fiber_tpu.init()
+    with DEVICE.transfer("unit", 77):
+        pass
+    client = AgentClient("127.0.0.1", embedded_agent.port)
+    try:
+        snap = client.call("device_snapshot")
+    finally:
+        client.close()
+    assert snap["pid"] == os.getpid()
+    assert snap["transfers"]["unit"]["bytes"] == 77
+    assert snap["hbm"]["bytes_in_use"] is None  # CPU: honest null
+
+
+def test_local_backend_cluster_devices():
+    from fiber_tpu.backends.local import LocalBackend
+
+    fiber_tpu.init()
+    out = LocalBackend().cluster_devices()
+    assert set(out) == {"local"}
+    assert "transfers" in out["local"] and "hbm" in out["local"]
+
+
+def test_device_stats_and_cli_over_sim_pool(monkeypatch, capsys):
+    """The acceptance path on a real sim:2 pod: a pool map with a
+    store-resolved broadcast arg, then Pool.device_stats() returning
+    per-host transfer bytes+seconds, compile count+seconds and HBM
+    stats (null-safe on CPU) for every cluster host, and the
+    `fiber-tpu devices` CLI rendering the same agents."""
+    from fiber_tpu import cli
+    from fiber_tpu.backends import get_backend, reset_backends
+
+    monkeypatch.setenv("FIBER_BACKEND", "tpu")
+    old = config.get().tpu_hosts
+    config.get().update(tpu_hosts="sim:2")
+    reset_backends()
+    try:
+        fiber_tpu.init(worker_lite=True, backend="tpu",
+                       tpu_hosts="sim:2", store_inline_max=64 * 1024)
+        arr = np.ones((200_000,), dtype=np.float64)
+        with fiber_tpu.Pool(4) as pool:
+            out = pool.starmap(targets.arr_sum_plus,
+                               [(arr, i) for i in range(12)],
+                               chunksize=1)
+            assert out == [float(arr.sum()) + i for i in range(12)]
+            stats = pool.device_stats()
+        # per-host agent snapshots, keyed like host_health
+        assert len(stats["hosts"]) == 2
+        for snap in stats["hosts"].values():
+            assert "error" not in snap
+            assert "transfer_bytes" in snap
+            assert "transfer_seconds" in snap
+            assert "compiles" in snap and "compile_seconds" in snap
+            assert snap["hbm"]["bytes_in_use"] is None  # CPU: honest
+        # the workers that resolved the broadcast shipped real numbers
+        assert stats["workers"]
+        assert any(
+            s["transfers"].get("store_resolve", {}).get("bytes", 0)
+            >= arr.nbytes for s in stats["workers"].values())
+        assert all(s["transfer_seconds"] > 0
+                   for s in stats["workers"].values()
+                   if s["transfers"])
+        # the CLI renders the same agents
+        hosts = ",".join(stats["hosts"])
+        assert cli.main(["devices", "--hosts", hosts]) == 0
+        rendered = capsys.readouterr().out
+        assert "XFER-B" in rendered
+        for key in stats["hosts"]:
+            assert key in rendered
+    finally:
+        try:
+            get_backend("tpu").shutdown_sim_cluster()
+        except Exception:  # noqa: BLE001
+            pass
+        config.get().update(tpu_hosts=old)
+        reset_backends()
+
+
+def test_devices_cli(embedded_agent, capsys):
+    from fiber_tpu import cli
+
+    fiber_tpu.init()
+    with DEVICE.transfer("store_resolve", 1 << 20):
+        pass
+    hosts = f"127.0.0.1:{embedded_agent.port}"
+    assert cli.main(["devices", "--hosts", hosts, "--sites"]) == 0
+    out = capsys.readouterr().out
+    assert "XFER-B" in out and "COMPILES" in out and "MFU" in out
+    assert hosts in out
+    assert "1.0MB" in out                 # the transfer we recorded
+    assert "store_resolve" in out         # --sites breakdown
+    # null HBM/MFU render '-', never 0
+    row = next(line for line in out.splitlines() if hosts in line)
+    assert " - " in row or row.rstrip().endswith("-")
+    # --json ships raw snapshots
+    assert cli.main(["devices", "--hosts", hosts, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc[hosts]["transfers"]["store_resolve"]["bytes"] == 1 << 20
+    # unreachable host: DOWN row + rc 1
+    assert cli.main(["devices", "--hosts", "127.0.0.1:1"]) == 1
+    assert "DOWN" in capsys.readouterr().out
+
+
+def test_top_renders_hbm_and_mfu_columns(embedded_agent, capsys):
+    from fiber_tpu import cli
+
+    fiber_tpu.init(monitor_interval_s=0.1)
+    hosts = f"127.0.0.1:{embedded_agent.port}"
+    assert cli.main(["top", "--hosts", hosts, "--iterations", "1",
+                     "--no-clear"]) == 0
+    out = capsys.readouterr().out
+    assert "HBM" in out and "MFU" in out
+    row = next(line for line in out.splitlines() if hosts in line)
+    assert "-" in row  # CPU host: honest dashes, not zeros
+
+
+def test_top_row_renders_device_numbers():
+    from fiber_tpu.cli import _render_top_rows
+
+    pulls = {"h1:7060": {
+        "timeseries": {"last": {"tasks_per_s": 5.0}},
+        "anomalies": {"active": {}},
+        "heartbeat_ages": {},
+        "device": {"hbm_bytes_in_use": 6 << 30,
+                   "hbm_bytes_limit": 16 << 30, "mfu": 0.423},
+    }}
+    row = _render_top_rows(pulls)[0]
+    assert "6.0GB/16.0GB" in row
+    assert "42.3%" in row
+
+
+def test_telemetry_snapshot_carries_device_surface():
+    fiber_tpu.init()
+    with DEVICE.transfer("unit", 5):
+        pass
+    snap = telemetry.snapshot()
+    assert snap["device"]["transfers"]["unit"]["count"] == 1

@@ -286,6 +286,42 @@ def test_explain_defaults_to_compute_when_nothing_is_wrong():
     assert verdict["primary"] == "compute"
 
 
+def test_explain_blames_transfer_with_bytes_evidence():
+    """Device telemetry plane: dominating host->device transfer seconds
+    name primary=transfer, with the transferred bytes as evidence
+    (docs/observability.md "Device telemetry")."""
+    events = [
+        {"ts": 0.05, "plane": "device", "kind": "transfer",
+         "site": "store_resolve", "bytes": 8 << 20, "s": 1.5},
+        {"ts": 0.06, "plane": "device", "kind": "transfer",
+         "site": "dmap", "bytes": 2 << 20, "s": 0.5},
+    ]
+    verdict = explain.explain_trace(_spans(), events)
+    assert verdict["primary"] == "transfer"
+    assert verdict["budget"]["transfer"] == pytest.approx(2.0)
+    ev = verdict["evidence"]["transfer"]
+    assert ev["transfers"] == 2
+    assert ev["bytes"] == (8 << 20) + (2 << 20)
+    rendered = explain.render(verdict)
+    assert "transfer" in rendered
+    assert str((8 << 20) + (2 << 20)) in rendered
+
+
+def test_explain_transfer_falls_back_to_spans():
+    """Artifacts recorded without the flight recorder still classify:
+    device.transfer spans are the fallback source."""
+    spans = _spans() + [
+        {"name": "device.transfer", "trace": "t1", "span": "sx",
+         "ts": 0.03, "dur": 3.0, "seq": 5, "bytes": 4 << 20,
+         "site": "deserialize"},
+    ]
+    verdict = explain.explain_trace(spans, [])
+    assert verdict["primary"] == "transfer"
+    assert verdict["evidence"]["transfer"]["bytes"] == 4 << 20
+    assert verdict["evidence"]["transfer"]["source"] == \
+        "device.transfer spans"
+
+
 def test_explain_roundtrips_through_chrome_trace(tmp_path):
     """The classifier reads the SAME Chrome artifact trace_dump writes
     (pid=host mapping inverted, ts/dur back to seconds)."""

@@ -5,7 +5,7 @@ PYTEST ?= python -m pytest tests/ -q
 
 .PHONY: test stest test-all lint bench bench-store bench-telemetry \
 	bench-sched bench-transport bench-cluster bench-recovery \
-	weakscale docs chaos
+	bench-accounting bench-check weakscale docs chaos
 
 # Tier 1: local backend (subprocess jobs)
 test:
@@ -47,7 +47,7 @@ bench:
 # CPU platform; JSON-lines record lands next to the driver's BENCH
 # files.
 bench-store:
-	JAX_PLATFORMS=cpu python bench.py --store | tee BENCH_store.json
+	JAX_PLATFORMS=cpu python bench.py --store --record | tee BENCH_store.json
 
 # Telemetry-plane overhead gate (docs/observability.md): small-task pool
 # throughput with telemetry off / metrics-only / full tracing / +flight
@@ -56,15 +56,32 @@ bench-store:
 # profiler arm exceeds 5% overhead on the microbench. The record lands
 # in BENCH_telemetry.json either way.
 bench-telemetry:
-	JAX_PLATFORMS=cpu python bench.py --telemetry > BENCH_telemetry.json; \
+	JAX_PLATFORMS=cpu python bench.py --telemetry --record > BENCH_telemetry.json; \
 	rc=$$?; cat BENCH_telemetry.json; exit $$rc
+
+# Accounting-plane gate (docs/observability.md "Resource accounting"):
+# small-task pool throughput with the cost ledger fully on (billing
+# keys on every envelope, per-frame wire attribution, worker cost
+# frames) vs telemetry off; FAILS past 5% overhead. The focused record
+# lands in BENCH_accounting.json (the full bench-telemetry run also
+# carries an accounting arm in BENCH_telemetry.json); --record appends
+# the trajectory to BENCH_history.jsonl for bench-check.
+bench-accounting:
+	JAX_PLATFORMS=cpu python bench.py --accounting --record > BENCH_accounting.json; \
+	rc=$$?; cat BENCH_accounting.json; exit $$rc
+
+# Bench-trajectory regression check: compares the latest recorded value
+# of every gated metric in BENCH_history.jsonl (written by --record)
+# against the best ever recorded; fails on a >10% regression.
+bench-check:
+	python scripts/bench_check.py
 
 # Scheduler-plane gate (docs/scheduling.md): uniform-workload overhead
 # of the adaptive scheduler vs fifo (must stay within 5%) and straggler
 # speculation on vs off under one chaos-slowed worker (must be >= 1.3x
 # faster). The record lands in BENCH_sched.json either way.
 bench-sched:
-	JAX_PLATFORMS=cpu python bench.py --sched > BENCH_sched.json; \
+	JAX_PLATFORMS=cpu python bench.py --sched --record > BENCH_sched.json; \
 	rc=$$?; cat BENCH_sched.json; exit $$rc
 
 # Transport I/O-core gate (docs/transport.md): selector event loop vs
@@ -73,7 +90,7 @@ bench-sched:
 # (CPU seconds + transport thread count). The record lands in
 # BENCH_transport.json either way.
 bench-transport:
-	JAX_PLATFORMS=cpu python bench.py --transport > BENCH_transport.json; \
+	JAX_PLATFORMS=cpu python bench.py --transport --record > BENCH_transport.json; \
 	rc=$$?; cat BENCH_transport.json; exit $$rc
 
 # Full-stack macro bench (docs/observability.md, ROADMAP item 5): the
@@ -85,7 +102,7 @@ bench-transport:
 # trace + flight-event artifact per run into RUNS/. The record lands
 # in BENCH_cluster.json either way.
 bench-cluster:
-	JAX_PLATFORMS=cpu python bench.py --cluster > BENCH_cluster.json; \
+	JAX_PLATFORMS=cpu python bench.py --cluster --record > BENCH_cluster.json; \
 	rc=$$?; cat BENCH_cluster.json; exit $$rc
 
 # Durable-map recovery gate (docs/robustness.md): write-ahead ledger
@@ -94,7 +111,7 @@ bench-cluster:
 # with an exactly-once restored/executed reconciliation. The record
 # lands in BENCH_recovery.json either way.
 bench-recovery:
-	JAX_PLATFORMS=cpu python bench.py --recovery > BENCH_recovery.json; \
+	JAX_PLATFORMS=cpu python bench.py --recovery --record > BENCH_recovery.json; \
 	rc=$$?; cat BENCH_recovery.json; exit $$rc
 
 # Weak-scaling record over 1/2/4/8-device sim meshes (fused ES,

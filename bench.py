@@ -38,8 +38,45 @@ def _round_mfu(value):
     return None if value is None else float(f"{value:.4g}")
 
 
+#: Bench-trajectory recording (``--record``): every emitted metric line
+#: also appends to BENCH_history.jsonl with run identity, so the perf
+#: trajectory across commits is visible (the BENCH_*.json files
+#: overwrite in place). scripts/bench_check.py flags gated-ratio
+#: regressions against the best recorded value.
+_RECORD: dict = {"path": None, "sha": "", "argv": ""}
+
+HISTORY_PATH = "BENCH_history.jsonl"
+
+
+def _arm_record(path: str = HISTORY_PATH) -> None:
+    import subprocess
+    import time as _time
+
+    sha = ""
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip()
+    except Exception:  # noqa: BLE001 - recording is best-effort
+        pass
+    _RECORD.update(path=path, sha=sha,
+                   argv=" ".join(sys.argv[1:]) or "(default)",
+                   ts=_time.time())
+
+
 def _emit(result: dict) -> None:
     print(json.dumps(result), flush=True)
+    if _RECORD["path"]:
+        entry = {"ts": round(float(_RECORD.get("ts") or 0.0), 3),
+                 "sha": _RECORD["sha"], "bench": _RECORD["argv"]}
+        entry.update(result)
+        try:
+            with open(_RECORD["path"], "a") as fh:
+                fh.write(json.dumps(entry) + "\n")
+        except OSError:
+            print("bench: could not append to history file",
+                  file=sys.stderr)
 
 
 def _probe_accelerator(timeout: float) -> str:
@@ -227,13 +264,14 @@ def _store_bench(args) -> int:
 _TELEMETRY_BUDGET = 1.05
 
 
-def _telemetry_bench(args) -> int:
+def _telemetry_bench(args, only=None) -> int:
     """Telemetry-plane overhead microbench (docs/observability.md):
     pool throughput on the reference's signature small-task workload
     with telemetry off / metrics-only / full tracing. Emits one JSON
     line per mode plus a summary line; exits nonzero when full-tracing
     overhead exceeds the 5% budget. Best-of-N walls so a CI scheduler
-    hiccup can't fail the gate."""
+    hiccup can't fail the gate. ``only`` restricts the arm set — the
+    ``--accounting`` shortcut runs just (off, accounting)."""
     os.environ["FIBER_BACKEND"] = "local"
     import fiber_tpu
 
@@ -243,38 +281,58 @@ def _telemetry_bench(args) -> int:
     # what it measured before the recorder existed, "flightrec" is
     # tracing + the recorder fully on (every plane hook emitting),
     # "monitor" adds the continuous sampler + anomaly watchdog at a
-    # 4x-tighter-than-default interval, and "profiler" adds the
-    # ~100 Hz stack sampler in the master AND every worker.
+    # 4x-tighter-than-default interval, "accounting" adds the cost
+    # ledger (billing keys on every envelope, per-frame wire billing,
+    # worker cost frames), and "profiler" adds the ~100 Hz stack
+    # sampler in the master AND every worker.
     modes = (
         ("off", dict(telemetry_enabled=False)),
         ("metrics", dict(telemetry_enabled=True, trace_sample_rate=0.0,
                          flightrec_enabled=False,
                          monitor_enabled=False,
-                         device_telemetry_enabled=False)),
+                         device_telemetry_enabled=False,
+                         accounting_enabled=False)),
         ("tracing", dict(telemetry_enabled=True, trace_sample_rate=1.0,
                          flightrec_enabled=False,
                          monitor_enabled=False,
-                         device_telemetry_enabled=False)),
+                         device_telemetry_enabled=False,
+                         accounting_enabled=False)),
         ("flightrec", dict(telemetry_enabled=True, trace_sample_rate=1.0,
                            flightrec_enabled=True,
                            monitor_enabled=False,
-                           device_telemetry_enabled=False)),
+                           device_telemetry_enabled=False,
+                           accounting_enabled=False)),
         ("monitor", dict(telemetry_enabled=True, trace_sample_rate=1.0,
                          flightrec_enabled=True, monitor_enabled=True,
                          monitor_interval_s=0.25,
-                         device_telemetry_enabled=False)),
+                         device_telemetry_enabled=False,
+                         accounting_enabled=False)),
         # device = monitor + the device telemetry plane fully on:
         # transfer accounting armed on every worker's resolve path and
         # the HBM/live-array gauge probe riding the 0.25s sampler tick.
         ("device", dict(telemetry_enabled=True, trace_sample_rate=1.0,
                         flightrec_enabled=True, monitor_enabled=True,
                         monitor_interval_s=0.25,
-                        device_telemetry_enabled=True)),
+                        device_telemetry_enabled=True,
+                        accounting_enabled=False)),
+        # accounting = monitor + the cost ledger fully on: billing key
+        # on every task envelope, per-frame wire attribution on the
+        # master's hot loops, per-chunk busy-second billing and
+        # cumulative cost frames on every worker.
+        ("accounting", dict(telemetry_enabled=True,
+                            trace_sample_rate=1.0,
+                            flightrec_enabled=True, monitor_enabled=True,
+                            monitor_interval_s=0.25,
+                            device_telemetry_enabled=False,
+                            accounting_enabled=True)),
         ("profiler", dict(telemetry_enabled=True, trace_sample_rate=1.0,
                           flightrec_enabled=True, monitor_enabled=True,
                           monitor_interval_s=0.25, profiler_hz=97.0,
-                          device_telemetry_enabled=False)),
+                          device_telemetry_enabled=False,
+                          accounting_enabled=False)),
     )
+    if only:
+        modes = tuple((m, o) for m, o in modes if m in only)
     walls = {}
     for mode, overrides in modes:
         fiber_tpu.init(worker_lite=True, **overrides)
@@ -294,17 +352,29 @@ def _telemetry_bench(args) -> int:
     fiber_tpu.init()
     overheads = {mode: round(walls[mode] / walls["off"], 4)
                  for mode in walls if mode != "off"}
-    gated = ("tracing", "flightrec", "monitor", "device", "profiler")
+    gated = tuple(m for m in ("tracing", "flightrec", "monitor",
+                              "device", "accounting", "profiler")
+                  if m in overheads)
     over = {mode: overheads[mode] > _TELEMETRY_BUDGET for mode in gated}
-    _emit({"metric": "pool_telemetry_overhead",
-           "value": overheads["tracing"], "unit": "x vs off",
-           "metrics_only_overhead": overheads["metrics"],
-           "flightrec_overhead": overheads["flightrec"],
-           "monitor_overhead": overheads["monitor"],
-           "device_overhead": overheads["device"],
-           "profiler_overhead": overheads["profiler"],
-           "budget": _TELEMETRY_BUDGET,
-           "over_budget": any(over.values())})
+    if only:
+        # Focused gate (`make bench-accounting`): one summary line per
+        # measured arm vs off.
+        for mode in gated:
+            _emit({"metric": f"pool_{mode}_overhead",
+                   "value": overheads[mode], "unit": "x vs off",
+                   "budget": _TELEMETRY_BUDGET,
+                   "over_budget": over[mode]})
+    else:
+        _emit({"metric": "pool_telemetry_overhead",
+               "value": overheads["tracing"], "unit": "x vs off",
+               "metrics_only_overhead": overheads["metrics"],
+               "flightrec_overhead": overheads["flightrec"],
+               "monitor_overhead": overheads["monitor"],
+               "device_overhead": overheads["device"],
+               "accounting_overhead": overheads["accounting"],
+               "profiler_overhead": overheads["profiler"],
+               "budget": _TELEMETRY_BUDGET,
+               "over_budget": any(over.values())})
     for mode in gated:
         if over[mode]:
             print(f"FAIL: {mode} overhead {overheads[mode]} exceeds "
@@ -1056,6 +1126,21 @@ def main() -> int:
                              "plane (runs on JAX_PLATFORMS=cpu)")
     parser.add_argument("--telemetry-reps", type=int, default=3,
                         help="walls per mode for --telemetry (best-of)")
+    parser.add_argument("--accounting", action="store_true",
+                        help="bench the accounting plane instead "
+                             "(docs/observability.md 'Resource "
+                             "accounting'): small-task pool throughput "
+                             "with the cost ledger fully on vs "
+                             "telemetry off; fails past 5%% overhead. "
+                             "Pure host plane (runs on "
+                             "JAX_PLATFORMS=cpu)")
+    parser.add_argument("--record", action="store_true",
+                        help="append every emitted metric line to "
+                             "BENCH_history.jsonl (ts, git sha, bench "
+                             "args) so the perf trajectory survives "
+                             "the in-place BENCH_*.json overwrites; "
+                             "scripts/bench_check.py flags regressions "
+                             "vs the best recorded value")
     parser.add_argument("--sched", action="store_true",
                         help="bench the scheduler plane instead "
                              "(docs/scheduling.md): uniform-workload "
@@ -1123,16 +1208,23 @@ def main() -> int:
         parser.error("--gens must be >= 1")
     if sum((args.poet, args.pixels, args.biped, args.attention,
             args.lm, args.store, args.telemetry, args.sched,
-            args.transport, args.cluster, args.recovery)) > 1:
+            args.transport, args.cluster, args.recovery,
+            args.accounting)) > 1:
         parser.error("--poet/--pixels/--biped/--attention/--lm/--store/"
                      "--telemetry/--sched/--transport/--cluster/"
-                     "--recovery are mutually exclusive")
+                     "--recovery/--accounting are mutually exclusive")
+    if args.record:
+        _arm_record()
     if args.store:
         # Host-plane only: no accelerator probe, no watchdog — the
         # store bench must run identically on a laptop and a pod host.
         return _store_bench(args)
     if args.telemetry:
         return _telemetry_bench(args)  # host-plane only, like --store
+    if args.accounting:
+        # Focused accounting-plane gate (`make bench-accounting`): the
+        # telemetry bench's off + accounting arms only.
+        return _telemetry_bench(args, only=("off", "accounting"))
     if args.sched:
         return _sched_bench(args)  # host-plane only, like --store
     if args.transport:

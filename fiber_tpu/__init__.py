@@ -24,6 +24,7 @@ __version__ = "0.1.0"
 
 from fiber_tpu import config  # noqa: F401
 from fiber_tpu.meta import meta  # noqa: F401
+from fiber_tpu.telemetry.accounting import CostBudget  # noqa: F401
 from fiber_tpu.context import FiberContext as _FiberContext
 
 _default_context = _FiberContext()

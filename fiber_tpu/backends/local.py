@@ -109,6 +109,14 @@ class LocalBackend(Backend):
         DEVICE.update_gauges()
         return {"local": DEVICE.snapshot()}
 
+    def cluster_costs(self) -> dict:
+        """Accounting snapshot, same one-host shape as
+        :meth:`cluster_metrics` (docs/observability.md "Resource
+        accounting")."""
+        from fiber_tpu.telemetry.accounting import COSTS
+
+        return {"local": COSTS.snapshot()}
+
     def collect_profiles(self, seconds: float = 1.0,
                          hz: float = 97.0) -> dict:
         """On-demand sampling profile of this process, same one-host

@@ -646,6 +646,14 @@ class TpuBackend(Backend):
         (docs/observability.md "Device telemetry")."""
         return self._sweep("device_snapshot")
 
+    def cluster_costs(self) -> Dict[str, dict]:
+        """Per-host accounting snapshots (agent ``cost_snapshot`` op):
+        each host process's billing-key -> cost-vector table — the data
+        plane of ``fiber-tpu top --costs``, keyed like
+        :meth:`cluster_metrics` (docs/observability.md "Resource
+        accounting")."""
+        return self._sweep("cost_snapshot")
+
     def _sweep(self, op: str, *args) -> Dict[str, dict]:
         """One telemetry RPC against every host, error-isolating — the
         shared shape of cluster_metrics / cluster_timeseries /

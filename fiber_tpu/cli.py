@@ -30,6 +30,9 @@ explain        classify where a traced map's time went (straggler /
                store-fetch) from a trace artifact + flight events
 postmortem     list/print black-box bundles (dead-worker flight events
                + stack dumps), locally or pulled from host agents
+cost           render one job's CostReport (per-map/per-tenant resource
+               accounting: tasks, cpu-seconds, wire bytes, store bytes,
+               device costs; --hosts pulls the live per-host ledgers)
 resume         resume a crashed durable map from its write-ahead ledger
                (``Pool.map(..., job_id=...)``): restore journaled
                results, re-execute only the remainder
@@ -818,18 +821,25 @@ def cmd_top(args) -> int:
     try:
         while True:
             pulls = {}
+            costs = {}
             for host, port in hosts:
                 key = f"{host}:{port}"
                 client = AgentClient(host, port)
                 try:
                     pulls[key] = client.call("monitor_snapshot",
                                              int(args.history))
+                    if args.costs:
+                        costs[key] = client.call("cost_snapshot")
                 except Exception as err:  # noqa: BLE001
                     pulls[key] = {"error": repr(err)}
                     rc = 1
                 finally:
                     client.close()
             if args.json:
+                if args.costs:
+                    for key in costs:
+                        if isinstance(pulls.get(key), dict):
+                            pulls[key]["costs"] = costs[key]
                 print(json.dumps(pulls, default=str))
             else:
                 if frames and not args.no_clear:
@@ -856,6 +866,10 @@ def cmd_top(args) -> int:
                         "%H:%M:%S", time.localtime(rec.get("wall", 0)))
                     print(f"  [{stamp}] {rec['host']} "
                           f"{rec.get('rule')}: {rec.get('detail')}")
+                if args.costs:
+                    print("costs (per billing key, top by cpu_s):")
+                    for row in _render_cost_rows(costs, args.last):
+                        print(row)
                 sys.stdout.flush()
             frames += 1
             if args.iterations and frames >= args.iterations:
@@ -863,6 +877,31 @@ def cmd_top(args) -> int:
             time.sleep(float(args.interval))
     except KeyboardInterrupt:
         return rc
+
+
+def _render_cost_rows(costs: dict, last: int = 8) -> list:
+    """Cost snapshots -> aligned rows (accounting plane, `fiber-tpu top
+    --costs`): per host, the top billing keys by worker busy-seconds,
+    with the overhead bucket shown explicitly."""
+    rows = []
+    for hkey in sorted(costs):
+        snap = costs[hkey]
+        table = (snap or {}).get("costs") or {}
+        ranked = sorted(
+            table.items(),
+            key=lambda kv: kv[1].get("cpu_s", 0.0)
+            + kv[1].get("wall_s", 0.0),
+            reverse=True)[:max(1, int(last))]
+        for kstr, vec in ranked:
+            rows.append(
+                f"  {hkey:<22} {kstr:<32} "
+                f"tasks={int(vec.get('tasks', 0)):>6} "
+                f"cpu={vec.get('cpu_s', 0.0):>8.2f}s "
+                f"wire={_human_bytes(vec.get('wire_tx', 0.0) + vec.get('wire_rx', 0.0)):>10} "
+                f"dev={vec.get('device_s', 0.0):>6.2f}s")
+        if not ranked:
+            rows.append(f"  {hkey:<22} (no billed keys)")
+    return rows
 
 
 def _render_device_rows(pulls) -> list:
@@ -1028,12 +1067,17 @@ def cmd_explain(args) -> int:
     except (OSError, ValueError) as err:
         raise SystemExit(f"error: cannot load trace: {err}") from None
     events = []
+    log_tail = []
     if args.flight:
         try:
             events = explainmod.load_events(args.flight)
         except (OSError, ValueError) as err:
             raise SystemExit(
                 f"error: cannot load flight events: {err}") from None
+        # The artifact's log-ring tail (logs pillar): rendered next to
+        # the blamed events so the operator sees what the process was
+        # logging, not just what its planes decided.
+        log_tail = explainmod.load_logs(args.flight)
     profile = None
     if getattr(args, "profile", ""):
         from fiber_tpu.telemetry import profiler as profmod
@@ -1050,9 +1094,15 @@ def cmd_explain(args) -> int:
     except ValueError as err:
         raise SystemExit(f"error: {err}") from None
     if args.json:
+        if log_tail:
+            verdict = dict(verdict, log_tail=log_tail)
         print(json.dumps(verdict))
     else:
         print(explainmod.render(verdict))
+        if log_tail:
+            print("recent log tail (flight artifact):")
+            for line in log_tail:
+                print(f"  {line}")
     return 0
 
 
@@ -1119,6 +1169,56 @@ def cmd_postmortem(args) -> int:
             print(f"{path}  unreadable ({err})", file=sys.stderr)
             continue
         print(f"{path}\n  {describe(bundle)}")
+    return 0
+
+
+def cmd_cost(args) -> int:
+    """``fiber-tpu cost <job_id>``: render one job's CostReport
+    (docs/observability.md "Resource accounting") — the record a
+    completed ``Pool.map(..., job_id=...)`` persisted beside its
+    ledger, or, with ``--hosts``, the live per-host cost ledgers
+    filtered to the job's billing keys."""
+    from fiber_tpu.telemetry import accounting
+
+    if args.hosts or getattr(args, "tpu", ""):
+        from fiber_tpu.backends.tpu import AgentClient
+
+        rc = 0
+        pulls = {}
+        for host, port in _resolve_cli_hosts(args):
+            key = f"{host}:{port}"
+            client = AgentClient(host, port)
+            try:
+                pulls[key] = client.call("cost_snapshot")
+            except Exception as err:  # noqa: BLE001
+                print(f"{key}  DOWN  ({err})", file=sys.stderr)
+                rc = 1
+            finally:
+                client.close()
+        if args.json:
+            print(json.dumps(pulls, default=str))
+            return rc
+        for hkey, snap in sorted(pulls.items()):
+            rows = [(kstr, vec) for kstr, vec
+                    in sorted((snap.get("costs") or {}).items())
+                    if accounting.parse_key(kstr)[1] == args.job_id]
+            print(f"{hkey}  pid={snap.get('pid')} "
+                  f"matching_keys={len(rows)}")
+            for kstr, vec in rows:
+                bits = " ".join(f"{f}={round(v, 4):g}"
+                                for f, v in sorted(vec.items()))
+                print(f"  {kstr}  {bits}")
+        return rc
+    record = accounting.read_job_record(args.job_id, args.dir or None)
+    if record is None:
+        raise SystemExit(
+            f"error: no cost record for job {args.job_id!r} under "
+            f"{args.dir or accounting.cost_dir()} (records are written "
+            "when a map submitted with job_id= completes)")
+    if args.json:
+        print(json.dumps(record, default=str))
+        return 0
+    print(accounting.render_report(record))
     return 0
 
 
@@ -1218,9 +1318,21 @@ def cmd_jobs(args) -> int:
             print(f"{job}  unreadable ({err})", file=sys.stderr)
             continue
         n_items = int(header.get("n_items") or 0)
-        print(f"{job}  tasks={n_items} "
-              f"journaled_chunks={len(completed)} "
-              f"{'done' if done else 'RESUMABLE'}")
+        line = (f"{job}  tasks={n_items} "
+                f"journaled_chunks={len(completed)} "
+                f"{'done' if done else 'RESUMABLE'}")
+        # Historical cost (accounting plane): the record a completed
+        # run persisted beside this ledger, when one exists.
+        from fiber_tpu.telemetry import accounting
+
+        record = accounting.read_job_record(job)
+        if record is not None:
+            total = record.get("total") or {}
+            line += (f"  cost: cpu={total.get('cpu_s', 0.0):.2f}s "
+                     f"wire={int(total.get('wire_tx', 0) + total.get('wire_rx', 0))}B "
+                     f"tasks={int(total.get('tasks', 0))}"
+                     f"+{int(total.get('tasks_restored', 0))}r")
+        print(line)
     return 0
 
 
@@ -1400,6 +1512,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="recent anomalies shown under the table")
     p.add_argument("--no-clear", action="store_true",
                    help="append frames instead of clearing the screen")
+    p.add_argument("--costs", action="store_true",
+                   help="also pull each host's accounting snapshot and "
+                        "show the top billing keys (tasks, cpu, wire, "
+                        "device seconds)")
     p.add_argument("--json", action="store_true",
                    help="print raw per-host monitor snapshots as JSON")
     p.set_defaults(fn=cmd_top)
@@ -1522,6 +1638,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--out", default="",
                    help="write the full result list (pickled) here")
     p.set_defaults(fn=cmd_resume)
+
+    p = sub.add_parser(
+        "cost", help="render one job's CostReport (per-map resource "
+                     "accounting: tasks, cpu, wire, store, device)")
+    p.add_argument("job_id", help="the job_id passed to Pool.map")
+    p.add_argument("--dir", default="",
+                   help="cost-record directory (default: config "
+                        "cost_dir or <staging root>/costs)")
+    p.add_argument("--hosts", default="",
+                   help="pull live per-host cost ledgers instead of "
+                        "the persisted record")
+    p.add_argument("--tpu", default="",
+                   help="TPU name: derive worker addresses via gcloud "
+                        "describe when --hosts is absent")
+    p.add_argument("--zone", default="")
+    p.add_argument("--port", type=int, default=0,
+                   help="port for portless --hosts entries / derived "
+                        "addresses")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw record/snapshots as JSON")
+    p.set_defaults(fn=cmd_cost)
 
     p = sub.add_parser("jobs",
                        help="list durable-map ledgers and their state")

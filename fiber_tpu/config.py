@@ -205,6 +205,20 @@ DEFAULTS: Dict[str, Any] = {
     # HBM fill fraction (bytes_in_use / bytes_limit, when the device
     # reports memory_stats) past which `hbm_fill` raises:
     "anomaly_hbm_fill_pct": 0.92,
+    # --- accounting plane (docs/observability.md "Resource accounting") ---
+    # Per-map/per-tenant cost attribution: billing keys ride the task
+    # envelope tail, workers ship cumulative ("cost", ...) frames, and
+    # Pool.cost()/`fiber-tpu cost` render per-job CostReports. Requires
+    # telemetry_enabled; off, every hook is one attribute check. Gated
+    # <= 5% by `make bench-accounting`.
+    "accounting_enabled": True,
+    # Tenant label billed for every map this process submits (the serve
+    # tier will stamp it per client); bounded per-job metric labels ride
+    # it (cost_tasks_total{tenant=,job=}).
+    "tenant": "default",
+    # Per-job cost record directory. "" = <staging root>/costs, beside
+    # the ledger/ directory `fiber-tpu jobs` reads.
+    "cost_dir": "",
     # --- TPU backend ---
     "tpu_name": "",
     "tpu_zone": "",

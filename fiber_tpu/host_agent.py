@@ -501,6 +501,15 @@ class HostAgent:
         DEVICE.update_gauges()  # extra-fresh HBM/live-array probe
         return DEVICE.snapshot()
 
+    def _op_cost_snapshot(self) -> dict:
+        """Accounting-plane surface for this host: the process cost
+        ledger's per-billing-key vectors (docs/observability.md
+        "Resource accounting") — the per-host payload of
+        ``TpuBackend.cluster_costs`` and ``fiber-tpu top --costs``."""
+        from fiber_tpu.telemetry.accounting import COSTS
+
+        return COSTS.snapshot()
+
     def _op_monitor_snapshot(self, history: int = 120) -> dict:
         """Continuous-monitor surface for this host: time-series rings,
         derived rates, heartbeat ages and the anomaly watchdog state —

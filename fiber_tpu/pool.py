@@ -42,7 +42,8 @@ from fiber_tpu.meta import get_meta
 from fiber_tpu.sched import Scheduler, local_host_key
 from fiber_tpu.store.core import ObjectRef
 from fiber_tpu.store.plane import StoreFetchError
-from fiber_tpu.telemetry import tracing
+from fiber_tpu.telemetry import accounting, tracing
+from fiber_tpu.telemetry.accounting import COSTS, CostBudget  # noqa: F401
 from fiber_tpu.telemetry.flightrec import FLIGHT
 from fiber_tpu.testing import chaos
 from fiber_tpu.transport import Endpoint, TransportClosed
@@ -76,6 +77,10 @@ _g_inflight = telemetry.gauge(
 
 DEFAULT_CHUNKSIZE = 32
 MAX_INFLIGHT_TASKS = 20000
+
+#: Process-wide map-id source for accounting billing keys: unique per
+#: submitted map across every pool in this master process.
+_MAP_IDS = itertools.count(1)
 
 _UNSET = object()
 
@@ -137,11 +142,17 @@ class ResultStore:
             self._completion_log[seq] = []
         return seq
 
-    def fill(self, seq: int, base: int, values: List[Any]) -> None:
+    def fill(self, seq: int, base: int, values: List[Any]) -> int:
+        """Fill result slots; duplicates (speculation losers, death
+        resubmits) are dropped here. Returns the number of NEWLY filled
+        slots — the accounting plane's exactly-once billing gate: a
+        task is billed when its slot first fills, so a duplicate
+        execution never re-bills it."""
+        newly = 0
         with self._cond:
             entry = self._entries.get(seq)
             if entry is None:
-                return
+                return 0
             if base < 0 or base + len(values) > entry.total:
                 raise ValueError(
                     f"result frame out of range: base={base} "
@@ -152,6 +163,7 @@ class ResultStore:
                 if entry.values[idx] is _UNSET:
                     entry.values[idx] = value
                     entry.remaining -= 1
+                    newly += 1
                     self._completion_log[seq].append(idx)
             callbacks = list(entry.callbacks) if entry.remaining == 0 else []
             self._cond.notify_all()
@@ -160,6 +172,7 @@ class ResultStore:
                 cb()
             except Exception:
                 logger.exception("pool callback failed")
+        return newly
 
     def ready(self, seq: int) -> bool:
         with self._cond:
@@ -820,6 +833,9 @@ def _pool_worker_core(
     # Last device-telemetry revision shipped to the master (list so the
     # per-chunk _ship_device closure can update it).
     dev_shipped = [0]
+    # Last accounting-ledger revision shipped (same posture: cumulative
+    # ("cost", ...) frames ride the result stream only when this moved).
+    cost_shipped = [0]
     # By-reference payloads: the store client is built lazily on the
     # first ref actually seen (most workers in small maps never pay the
     # import), shared across chunks so broadcast args resolve once per
@@ -922,10 +938,13 @@ def _pool_worker_core(
                 reason = "exit"
                 break
             # 7-tuple envelopes predate the telemetry plane; the trace
-            # context rides as an optional 8th field so replayed/stored
-            # payloads of either shape decode.
+            # context rides as an optional 8th field and the accounting
+            # billing key as an optional 9th, so replayed/stored
+            # payloads of any shape decode.
             seq, base, digest, blob, chunk, star = msg[1:7]
             tctx = msg[7] if len(msg) > 7 else None
+            bkey = (tuple(msg[8]) if len(msg) > 8 and msg[8] is not None
+                    else None)
             if FLIGHT.enabled:
                 # One event per chunk: the dead-worker bundle must show
                 # what the worker was chewing on when it died.
@@ -952,7 +971,7 @@ def _pool_worker_core(
                     # lost spans frame costs observability, never
                     # results.
                     result_ep.send(serialization.dumps(
-                        ("spans", ident, finished)))
+                        ("spans", ident, finished, bkey)))
                 except (TransportClosed, OSError):
                     pass
 
@@ -972,7 +991,8 @@ def _pool_worker_core(
                 try:
                     result_ep.send(serialization.dumps(
                         ("prof", ident,
-                         f"{tracing.host_id()}:{fiber_pid}", folded)))
+                         f"{tracing.host_id()}:{fiber_pid}", folded,
+                         bkey)))
                 except (TransportClosed, OSError):
                     pass
 
@@ -993,6 +1013,27 @@ def _pool_worker_core(
                 try:
                     result_ep.send(serialization.dumps(
                         ("dev", ident,
+                         f"{tracing.host_id()}:{fiber_pid}", snap,
+                         bkey)))
+                except (TransportClosed, OSError):
+                    pass
+
+            def _ship_cost() -> None:
+                # Accounting plane (docs/observability.md "Resource
+                # accounting"): this worker's per-billing-key cost
+                # vectors (chunk busy-seconds, store fetches, device
+                # transfers) ride the result stream as a CUMULATIVE
+                # snapshot keyed host:pid — the device-frame posture:
+                # latest wins on the master, shipped only when the
+                # ledger revision moved so idle workers cost nothing.
+                if not COSTS.enabled \
+                        or COSTS.revision == cost_shipped[0]:
+                    return
+                snap = COSTS.snapshot()
+                cost_shipped[0] = snap["revision"]
+                try:
+                    result_ep.send(serialization.dumps(
+                        ("cost", ident,
                          f"{tracing.host_id()}:{fiber_pid}", snap)))
                 except (TransportClosed, OSError):
                     pass
@@ -1007,6 +1048,7 @@ def _pool_worker_core(
                 # route around it.
                 plan.maybe_hang_worker(completed_chunks)
                 plan.maybe_slow_worker(completed_chunks)
+            chunk_t0 = time.perf_counter()
             with contextlib.ExitStack() as tstack:
                 if tctx is not None:
                     # Adopt the master's trace so every span below
@@ -1014,6 +1056,11 @@ def _pool_worker_core(
                     # serialize span.
                     tstack.enter_context(
                         tracing.trace_context(tctx[0], tctx[1]))
+                if bkey is not None and COSTS.enabled:
+                    # Ambient billing key for the whole chunk: store
+                    # fetches and device transfers inside it bill to
+                    # the map that caused them, not to overhead.
+                    tstack.enter_context(COSTS.context(bkey))
                 if _chunk_has_refs(chunk):
                     try:
                         with _wspan("worker.resolve_refs"), \
@@ -1032,7 +1079,13 @@ def _pool_worker_core(
                             err, seq, base)
                         result_ep.send(serialization.dumps(
                             ("storemiss", seq, base, len(chunk), ident)))
+                        if bkey is not None and COSTS.enabled:
+                            # The failed resolve was still work this
+                            # map caused; no tasks executed though.
+                            COSTS.charge(bkey, cpu_s=(
+                                time.perf_counter() - chunk_t0))
                         _ship_spans()
+                        _ship_cost()
                         # The handout is consumed even though nothing
                         # ran: the resilient fetch thread budgets
                         # FETCHED chunks (maxtasksperchild), so skipping
@@ -1053,12 +1106,21 @@ def _pool_worker_core(
                         values = _encode_results(values, get_store_client,
                                                  store_addr,
                                                  store_inline_max)
+            if bkey is not None and COSTS.enabled:
+                # Chunk busy-seconds (resolve + execute + encode wall)
+                # and executions INCLUDING duplicates — the master's
+                # first-fill `tasks` count is the exactly-once side;
+                # the difference is the duplicate count.
+                COSTS.charge(bkey,
+                             cpu_s=time.perf_counter() - chunk_t0,
+                             tasks_executed=len(chunk))
             result_ep.send(
                 serialization.dumps(("result", seq, base, values, ident))
             )
             _ship_spans()
             _ship_profile()
             _ship_device()
+            _ship_cost()
             completed_chunks += 1
             if plan is not None:
                 plan.maybe_kill_worker(completed_chunks)
@@ -1107,6 +1169,18 @@ class Pool:
         #: Latest device-telemetry snapshot per worker (host:pid), from
         #: the ("dev", ...) result-stream frames — Pool.device_stats().
         self._device_workers: Dict[str, dict] = {}
+        #: Accounting plane (docs/observability.md "Resource
+        #: accounting"): latest cumulative cost snapshot per worker
+        #: (host:pid) from ("cost", ...) frames; seq -> billing key for
+        #: this pool's in-flight maps; seq -> map-start perf_counter
+        #: (wall_s billing); completed billing key -> job_id so a cost
+        #: frame landing AFTER the last result still refreshes the
+        #: persisted per-job record.
+        self._cost_workers: Dict[str, dict] = {}
+        self._seq_bill: Dict[int, Tuple[str, str, str]] = {}
+        self._map_wall0: Dict[int, float] = {}
+        self._job_records: Dict[Tuple[str, str, str], str] = {}
+        self._map_budgets: Dict[Tuple[str, str, str], CostBudget] = {}
         if processes is None:
             processes = get_backend().default_pool_size()
         if processes < 1:
@@ -1451,6 +1525,31 @@ class Pool:
                 continue
         return False
 
+    # -- accounting plane (docs/observability.md "Resource accounting") ----
+    def _bill_frame(self, seq: Optional[int], tx: int = 0, rx: int = 0,
+                    dispatch_s: float = 0.0,
+                    bkey: Optional[Tuple] = None) -> None:
+        """Bill one pool frame's wire bytes (payload length -> framing
+        wire size) and optional dispatch seconds to its map — by
+        ``seq`` (the master's seq -> key table), by an explicit
+        worker-tagged ``bkey``, or to the overhead bucket when neither
+        attributes it (heartbeats, frames of completed maps). The
+        master is the authoritative wire observation point: every pool
+        frame crosses its endpoints exactly once."""
+        if not COSTS.enabled:
+            return
+        key = tuple(bkey) if bkey else (
+            self._seq_bill.get(seq) if seq is not None else None)
+        fields: Dict[str, float] = {}
+        if tx:
+            fields["wire_tx"] = accounting.wire_size(tx)
+        if rx:
+            fields["wire_rx"] = accounting.wire_size(rx)
+        if dispatch_s:
+            fields["dispatch_s"] = dispatch_s
+        if fields:
+            COSTS.charge(key, **fields)
+
     # -- task egress -------------------------------------------------------
     def _task_loop(self) -> None:
         """Move tasks from the local queue onto the wire with explicit
@@ -1487,6 +1586,8 @@ class Pool:
                     # successful handout is recorded.
                     global_timer.add("pool.dispatch",
                                      time.perf_counter() - t0)
+                    self._bill_frame(item[1][0], tx=len(payload),
+                                     dispatch_s=time.perf_counter() - t0)
                     _m_chunks_dispatched.inc()
                     if FLIGHT.enabled:
                         FLIGHT.record("pool", "dispatch",
@@ -1512,42 +1613,64 @@ class Pool:
                 if msg[0] == "hb":
                     if detector is not None:
                         detector.beat(msg[1])
+                    # Heartbeats are traffic no map causes: the
+                    # explicit overhead bucket.
+                    self._bill_frame(None, rx=len(data))
                     continue
                 if msg[0] == "spans":
                     # Worker-side trace spans riding the result stream
                     # (same transport posture as heartbeats): fold them
                     # into the master's ring buffer, where trace_dump
-                    # assembles the cluster-wide timeline.
+                    # assembles the cluster-wide timeline. The optional
+                    # 4th field is the causing chunk's billing key.
                     if detector is not None:
                         detector.beat(msg[1])
                     tracing.SPANS.add_all(msg[2])
+                    self._bill_frame(None, rx=len(data),
+                                     bkey=msg[3] if len(msg) > 3 else None)
                     continue
                 if msg[0] == "prof":
                     # Worker-side sampling-profiler stacks (same
                     # posture as spans): merge into the master's
                     # cluster aggregate, keyed by the worker's
                     # host:pid label (Pool.profile_dump renders it).
-                    _, ident, label, folded = msg
+                    ident, label, folded = msg[1], msg[2], msg[3]
                     if detector is not None:
                         detector.beat(ident)
                     from fiber_tpu.telemetry.profiler import AGGREGATE
 
                     AGGREGATE.merge(label, folded)
+                    self._bill_frame(None, rx=len(data),
+                                     bkey=msg[4] if len(msg) > 4 else None)
                     continue
                 if msg[0] == "dev":
                     # Worker-side device-telemetry snapshots (transfer
                     # accounting, compiles — docs/observability.md
                     # "Device telemetry"): cumulative per worker, so
                     # latest wins; Pool.device_stats() renders them.
-                    _, ident, label, snap = msg
+                    ident, label, snap = msg[1], msg[2], msg[3]
                     if detector is not None:
                         detector.beat(ident)
                     self._device_workers[str(label)] = snap
+                    self._bill_frame(None, rx=len(data),
+                                     bkey=msg[4] if len(msg) > 4 else None)
+                    continue
+                if msg[0] == "cost":
+                    # Worker cost frames (accounting plane): cumulative
+                    # per worker, latest wins; Pool.cost() merges them
+                    # over the master's own ledger. Their own wire cost
+                    # is accounting traffic -> overhead.
+                    ident, label, snap = msg[1], msg[2], msg[3]
+                    if detector is not None:
+                        detector.beat(ident)
+                    self._on_cost_frame(str(label), snap)
+                    self._bill_frame(None, rx=len(data))
                     continue
                 if msg[0] == "storemiss":
                     _, seq, base, n, ident = msg
                     if detector is not None:
                         detector.beat(ident)  # a report proves liveness
+                    self._bill_frame(seq, rx=len(data))
                     self._on_store_miss(seq, base, n, ident)
                     continue
                 if msg[0] != "result":
@@ -1559,6 +1682,7 @@ class Pool:
                     # still making progress, and progress must never
                     # read as death.
                     detector.beat(ident)
+                self._bill_frame(seq, rx=len(data))
                 if any(isinstance(v, ObjectRef) for v in values):
                     with global_timer.section("pool.store_resolve"):
                         values = self._resolve_result_refs(values)
@@ -1570,7 +1694,19 @@ class Pool:
                     # loop; the ledger's writer thread owns the
                     # serialize + disk persist + fsync.
                     self._journal_chunk(seq, base, values)
-                self._store.fill(seq, base, values)
+                # Billing key captured BEFORE the fill: the fill that
+                # completes the map fires the completion callbacks
+                # (which seal and release the key) synchronously, and
+                # the final chunk's tasks must still bill.
+                bill_key = (self._seq_bill.get(seq) if COSTS.enabled
+                            else None)
+                newly = self._store.fill(seq, base, values)
+                if newly and bill_key is not None:
+                    # Exactly-once task billing: the first fill of each
+                    # slot bills it; a speculation duplicate or
+                    # death/storemiss resubmit fills nothing new and
+                    # bills nothing.
+                    COSTS.charge(bill_key, tasks=newly)
                 _g_inflight.set(self._store.outstanding())
             except Exception:
                 logger.exception("pool: dropping malformed result frame")
@@ -1579,23 +1715,25 @@ class Pool:
         pass
 
     # -- by-reference payloads (fiber_tpu/store) ---------------------------
-    def _encode_items(self, items: List[Any],
-                      seq_digests: List[str]) -> List[Any]:
+    def _encode_items(self, items: List[Any], seq_digests: List[str],
+                      bkey=None) -> List[Any]:
         """Replace large args with ObjectRefs (top level and one tuple
         level deep, which covers map-over-tuples and starmap). The memo
         keys on object identity so the classic broadcast pattern — the
         same params object in every item — is hashed and stored ONCE
-        per map, not once per task."""
+        per map, not once per task. ``bkey`` bills each stored payload
+        to the submitting map (accounting plane)."""
         memo: Dict[int, Tuple[Any, Any]] = {}
-        return [self._encode_item(it, memo, seq_digests) for it in items]
+        return [self._encode_item(it, memo, seq_digests, bkey)
+                for it in items]
 
-    def _encode_item(self, item, memo, seq_digests):
+    def _encode_item(self, item, memo, seq_digests, bkey=None):
         if type(item) is tuple:
-            return tuple(self._encode_obj(e, memo, seq_digests)
+            return tuple(self._encode_obj(e, memo, seq_digests, bkey)
                          for e in item)
-        return self._encode_obj(item, memo, seq_digests)
+        return self._encode_obj(item, memo, seq_digests, bkey)
 
-    def _encode_obj(self, obj, memo, seq_digests):
+    def _encode_obj(self, obj, memo, seq_digests, bkey=None):
         if isinstance(obj, ObjectRef):
             return obj  # user pre-put it; ships as-is
         key = id(obj)
@@ -1615,18 +1753,20 @@ class Pool:
         ref = self._objstore.put_bytes(data, refs=1,
                                        owner=self._store_addr)
         seq_digests.append(ref.digest)
+        if bkey is not None:
+            COSTS.charge(bkey, store_put_bytes=len(data))
         # The memo holds the original object alive so its id() cannot
         # be recycled mid-encode.
         memo[key] = (obj, ref)
         return ref
 
     def _arm_store_fallback(self, seq, digest, blob, star, items,
-                            seq_digests, tctx) -> None:
+                            seq_digests, tctx, bkey=None) -> None:
         """Keep enough context to resend any chunk inline (storemiss),
         and release the map's store refs when it completes (success,
         failure or abort — completion callbacks fire on all three)."""
         with self._seq_ctx_lock:
-            self._seq_ctx[seq] = (digest, blob, star, items, tctx)
+            self._seq_ctx[seq] = (digest, blob, star, items, tctx, bkey)
         # The active broadcast is precious while the map is in flight:
         # the replication hook copies it off a suspect host so recovery
         # (and late locality fetches) never need the dead one.
@@ -1672,12 +1812,14 @@ class Pool:
             ctx = self._seq_ctx.get(seq)
         if ctx is None or self._store.is_done(seq):
             return
-        fdigest, blob, star, items, tctx = ctx
+        fdigest, blob, star, items, tctx, bkey = ctx
         chunk = items[base:base + n]
-        # Same trace context as the original handout: the inline resend
-        # is one more hop of the same logical task, not a new trace.
+        # Same trace context (and billing key) as the original handout:
+        # the inline resend is one more hop of the same logical task,
+        # not a new trace — and its duplicate wire bytes bill to the
+        # map that caused them.
         payload = serialization.dumps(
-            ("task", seq, base, fdigest, blob, chunk, star, tctx)
+            ("task", seq, base, fdigest, blob, chunk, star, tctx, bkey)
         )
         self._store_fallbacks += 1
         _m_store_fallbacks.inc()
@@ -1935,6 +2077,107 @@ class Pool:
             out.update(self._store_server.stats())
         return out
 
+    # -- accounting plane read side ----------------------------------------
+    def _on_cost_frame(self, label: str, snap: dict) -> None:
+        """One worker's cumulative cost snapshot landed: latest wins per
+        worker. Budgets re-check with the worker-observed fields merged
+        in (cpu_s lives only on workers), and persisted per-job records
+        of already-completed jobs the frame touches are refreshed — the
+        final chunk's cost frame always lands AFTER the last result."""
+        self._cost_workers[label] = snap
+        if not COSTS.enabled:
+            return
+        workers = accounting.merge_worker_costs(self._cost_workers)
+        for kstr in (snap.get("costs") or {}):
+            key = accounting.parse_key(kstr)
+            if key[2] == "overhead":
+                continue
+            COSTS.check_budget(key, extra=workers.get(kstr))
+            job_id = self._job_records.get(key)
+            if job_id is not None:
+                accounting.write_job_record(job_id,
+                                            self._cost_report_for(key))
+
+    def _cost_report_for(self, key) -> Dict[str, Any]:
+        kstr = accounting.key_str(key)
+        workers = accounting.merge_worker_costs(self._cost_workers)
+        return accounting.build_report(
+            key, COSTS.vector(key), workers.get(kstr, {}),
+            self._map_budgets.get(tuple(key)))
+
+    def _finish_billing(self, seq: int, job_id, ledger, budget) -> None:
+        """Map completion (success, failure or abort): seal the map's
+        cost — wall clock, final ledger disk bytes — release its budget
+        state and per-job metric label slots, and persist the per-job
+        cost record beside the PR-7 ledger when the map was durable."""
+        key = self._seq_bill.pop(seq, None)
+        if key is None:
+            return
+        t0 = self._map_wall0.pop(seq, None)
+        if t0 is not None:
+            COSTS.charge(key, wall_s=time.perf_counter() - t0)
+        if ledger is not None:
+            COSTS.charge(key, ledger_bytes=ledger.bytes_written)
+        COSTS.release_key(key)
+        if job_id is not None:
+            # Remembered (bounded) so a cost frame landing after the
+            # last result still refreshes the record (_on_cost_frame).
+            self._job_records[key] = job_id
+            while len(self._job_records) > 16:
+                self._job_records.pop(next(iter(self._job_records)))
+            accounting.write_job_record(job_id,
+                                        self._cost_report_for(key))
+
+    def cost(self, job_id: Optional[str] = None) -> Dict[str, Any]:
+        """Per-map/per-tenant CostReports (docs/observability.md
+        "Resource accounting"): the process cost ledger's keys merged
+        with every worker's shipped cost frames, each field taken from
+        its authoritative observation point (wire/tasks: master;
+        cpu/store-fetch/device-transfer: workers). ``job_id=`` filters
+        to that job's maps and adds an aggregated ``job`` summary.
+        The ``overhead`` buckets (master and workers) are explicit —
+        per-key wire bytes + overhead always sum to ``totals``."""
+        snap = COSTS.snapshot()
+        workers = accounting.merge_worker_costs(self._cost_workers)
+        over_str = accounting.key_str(accounting.OVERHEAD_KEY)
+        reports = []
+        for kstr in sorted(snap["costs"]):
+            key = accounting.parse_key(kstr)
+            if key[2] == "overhead":
+                continue
+            if job_id is not None and key[1] != job_id:
+                continue
+            reports.append(accounting.build_report(
+                key, snap["costs"][kstr], workers.get(kstr, {}),
+                self._map_budgets.get(key)))
+        out: Dict[str, Any] = {
+            "reports": reports,
+            "overhead": dict(snap["costs"].get(over_str) or {}),
+            "worker_overhead": dict(workers.get(over_str) or {}),
+            "totals": COSTS.totals(),
+            "cost_workers": len(self._cost_workers),
+            # Exact framing-boundary counters of this pool's endpoints:
+            # billed wire (per-key + overhead) reconciles against these
+            # — the remainder is credit/flow-control traffic the pool
+            # layer never sees, reported here instead of silently
+            # dropped.
+            "transport": {
+                "task_ep": {"bytes_tx": self._task_ep.bytes_tx,
+                            "bytes_rx": self._task_ep.bytes_rx},
+                "result_ep": {"bytes_tx": self._result_ep.bytes_tx,
+                              "bytes_rx": self._result_ep.bytes_rx},
+            },
+        }
+        if job_id is not None:
+            job_total: Dict[str, float] = {}
+            for rep in reports:
+                for field, n in rep["total"].items():
+                    job_total[field] = job_total.get(field, 0.0) + n
+            out["job"] = {"job_id": job_id, "maps": len(reports),
+                          "total": {k: round(v, 6)
+                                    for k, v in sorted(job_total.items())}}
+        return out
+
     # -- telemetry (docs/observability.md) ---------------------------------
     def stats(self) -> Dict[str, Any]:
         """Aggregated pool introspection: the global_timer's ``pool.*``
@@ -1954,6 +2197,13 @@ class Pool:
             "outstanding": self._store.outstanding(),
             "workers": len(self._workers),
             "sched": self._sched.snapshot(),
+            # Accounting-plane summary (full reports: Pool.cost()).
+            "costs": {
+                kstr: {"tasks": vec.get("tasks", 0.0),
+                       "wire_tx": vec.get("wire_tx", 0.0),
+                       "wire_rx": vec.get("wire_rx", 0.0)}
+                for kstr, vec in COSTS.snapshot()["costs"].items()
+            } if COSTS.enabled else {},
         }
 
     def metrics(self) -> Dict[str, dict]:
@@ -2078,10 +2328,16 @@ class Pool:
         explain`` joins with the trace. Returns ``path``."""
         import json
 
+        from fiber_tpu.utils.logging import LOG_RING
+
         with open(path, "w") as fh:
             json.dump({"host": tracing.host_id(), "pid": os.getpid(),
                        "dropped": FLIGHT.dropped,
-                       "events": FLIGHT.snapshot()}, fh, default=str)
+                       "events": FLIGHT.snapshot(),
+                       # Log-ring tail: `fiber-tpu explain --flight`
+                       # shows what the process was LOGGING next to the
+                       # events it blames (docs/observability.md).
+                       "logs": LOG_RING.tail(200)}, fh, default=str)
         return path
 
     # -- submission --------------------------------------------------------
@@ -2096,6 +2352,7 @@ class Pool:
         single: bool = False,
         priority: float = 1.0,
         job_id: Optional[str] = None,
+        budget: Optional[CostBudget] = None,
     ) -> AsyncResult:
         if self._closed or self._terminated:
             raise ValueError("Pool not running")
@@ -2107,6 +2364,27 @@ class Pool:
                                   callback, error_callback)
         if not items:
             return result
+        # Accounting plane (docs/observability.md "Resource
+        # accounting"): every map gets a (tenant, job, map) billing key
+        # that rides the task envelope tail; the map's serialize /
+        # dispatch / wire / fill observations bill to it, workers bill
+        # their chunk costs to the same key, and an optional CostBudget
+        # raises the budget_exceeded anomaly when crossed.
+        mid = next(_MAP_IDS)
+        bill_key = (COSTS.tenant,
+                    job_id if job_id is not None else f"map-{mid}",
+                    f"m{mid}")
+        if COSTS.enabled:
+            self._seq_bill[seq] = bill_key
+            self._map_wall0[seq] = time.perf_counter()
+            if budget is not None:
+                COSTS.set_budget(bill_key, budget)
+                self._map_budgets[bill_key] = budget
+                while len(self._map_budgets) > 64:
+                    self._map_budgets.pop(next(iter(self._map_budgets)))
+        elif budget is not None:
+            logger.warning("accounting disabled; budget for job %r is "
+                           "not enforced", job_id)
         if chunksize is None:
             # Ceil division (multiprocessing's formula): floor leaves a
             # remainder chunk that lands as one worker's straggler tail —
@@ -2147,7 +2425,14 @@ class Pool:
                 ledger, completed = None, {}
         restorable: Dict[int, List[Any]] = {}
         if completed:
+            restore_t0 = time.perf_counter()
             restorable = self._ledger_restore_all(job_id, completed)
+            if COSTS.enabled:
+                # Restored chunks bill RESTORE cost, never execute
+                # cost: the journaled results are fetched, not re-run
+                # (tasks_restored is charged at the fill below).
+                COSTS.charge(bill_key, restore_s=(
+                    time.perf_counter() - restore_t0))
         # Scheduler registration before any chunk is queued: priority is
         # the WDRR weight across concurrently active maps; the map's
         # state (queued duplicates included) is dropped at completion.
@@ -2158,6 +2443,13 @@ class Pool:
             self._ledgers[seq] = ledger
             self._store.add_callback(seq,
                                      lambda: self._ledger_done(seq))
+        if COSTS.enabled:
+            # Registered AFTER the ledger-done callback so the writer
+            # thread has closed (bytes_written is final) when the
+            # map's cost is sealed and its job record persisted.
+            self._store.add_callback(
+                seq, lambda: self._finish_billing(seq, job_id, ledger,
+                                                  budget))
         self._n_submitted += len(items)
         _m_tasks_submitted.inc(len(items))
         spans = _chunk_spans(len(items), chunksize)
@@ -2178,6 +2470,8 @@ class Pool:
                                   seq=seq, items=len(items))
                      if trace_id and pending else contextlib.nullcontext())
         if pending:
+            ser_t0 = time.perf_counter()
+            env_key = bill_key if COSTS.enabled else None
             with global_timer.section("pool.serialize"), root_span as sp:
                 tctx = (trace_id, sp["span"]) if sp is not None else None
                 blob = serialization.dumps(func)
@@ -2188,7 +2482,8 @@ class Pool:
                     try:
                         with global_timer.section("pool.store_encode"):
                             enc_items = self._encode_items(items,
-                                                           seq_digests)
+                                                           seq_digests,
+                                                           env_key)
                     except Exception:  # noqa: BLE001 - optimization only
                         logger.warning(
                             "store: arg encoding failed; shipping inline",
@@ -2197,7 +2492,8 @@ class Pool:
                         seq_digests = []
                     if seq_digests:
                         self._arm_store_fallback(seq, digest, blob, star,
-                                                 items, seq_digests, tctx)
+                                                 items, seq_digests, tctx,
+                                                 env_key)
                         # Locality seed: this host's store owns the refs,
                         # and the backend may know other hosts that
                         # already cache them (prestaged via put_object).
@@ -2211,9 +2507,12 @@ class Pool:
                         self._sched.register_chunk((seq, base), digs)
                     payload = serialization.dumps(
                         ("task", seq, base, digest, blob, chunk, star,
-                         tctx)
+                         tctx, env_key)
                     )
                     self._taskq.put((payload, (seq, base)))
+            if COSTS.enabled:
+                COSTS.charge(bill_key, serialize_s=(
+                    time.perf_counter() - ser_t0))
         if restorable:
             # Journaled chunks fill directly — never re-executed, never
             # re-dispatched; exactly one result per task is the ledger's
@@ -2225,6 +2524,12 @@ class Pool:
                 self._store.fill(seq, base, values)
                 n_restored += len(values)
             self._n_restored += n_restored
+            if COSTS.enabled:
+                # Exactly-once across crashes: restored tasks bill as
+                # tasks_restored, never as executed/billed tasks (the
+                # result loop only bills frames, and restored chunks
+                # never cross the wire again).
+                COSTS.charge(bill_key, tasks_restored=n_restored)
             logger.warning(
                 "ledger: job %r resumed — restored %d/%d chunks "
                 "(%d tasks) from the journal; executing %d chunks",
@@ -2293,23 +2598,37 @@ class Pool:
         t0 = time.perf_counter()
         out = device_map(func, items, star=star)
         wall = time.perf_counter() - t0
+        flops_meta = get_meta(func).get("flops")
+        if COSTS.enabled and items:
+            # Device maps bill too: one mesh call, no wire — device
+            # seconds, task count and (when @meta declares the analytic
+            # cost) FLOPs, under a key of their own.
+            mid = next(_MAP_IDS)
+            dev_key = (COSTS.tenant, f"map-{mid}", f"m{mid}")
+            fields: Dict[str, float] = {
+                "device_s": wall, "wall_s": wall,
+                "tasks": float(len(items)),
+            }
+            if flops_meta:
+                fields["flops"] = float(flops_meta) * len(items)
+            COSTS.charge(dev_key, **fields)
+            COSTS.release_key(dev_key)
         # Live MFU (docs/observability.md "Device telemetry"): a
         # function declaring its analytic cost (@meta(device=True,
         # flops=<per item>) — utils/flops.py counters supply the
         # number) lands its achieved MFU in the pool_map_mfu gauge
         # whenever the device peak resolves; CPU runs record None
         # honestly, exactly the bench-cluster posture.
-        flops_per_item = get_meta(func).get("flops")
-        if flops_per_item and items:
+        if flops_meta and items:
             from fiber_tpu.telemetry.device import DEVICE
 
-            DEVICE.note_map_flops(float(flops_per_item) * len(items),
+            DEVICE.note_map_flops(float(flops_meta) * len(items),
                                   wall, len(items))
         return out
 
     def _dispatch_async(self, func, items, star, chunksize,
                         callback, error_callback, priority=1.0,
-                        job_id=None):
+                        job_id=None, budget=None):
         """Device-or-host submission shared by every map variant, with
         async error contracts preserved on the device path (user-function
         errors reach error_callback / .get(); only pool-state errors
@@ -2325,7 +2644,8 @@ class Pool:
         if not self._wants_device(func):
             return self._submit(func, items, chunksize, star,
                                 callback, error_callback,
-                                priority=priority, job_id=job_id)
+                                priority=priority, job_id=job_id,
+                                budget=budget)
         if job_id is not None:
             # Device dispatch is one mesh call, not a chunk stream —
             # there is nothing partial to journal or resume.
@@ -2364,6 +2684,7 @@ class Pool:
         chunksize: Optional[int] = None,
         priority: float = 1.0,
         job_id: Optional[str] = None,
+        budget: Optional[CostBudget] = None,
     ) -> List[Any]:
         """``job_id=`` makes the map durable (docs/robustness.md): the
         task spec and every completed chunk are journaled write-ahead
@@ -2371,9 +2692,15 @@ class Pool:
         survivable — ``fiber-tpu resume <job_id>`` (or re-calling map
         with the same job_id) restores completed results and re-executes
         only the remainder. Tasks must be idempotent (the resilient-pool
-        contract already requires this)."""
+        contract already requires this).
+
+        ``budget=`` sets soft :class:`CostBudget` caps for the map
+        (docs/observability.md "Resource accounting"): crossing any cap
+        raises the ``budget_exceeded`` watchdog anomaly + flight event.
+        Measurement, not enforcement — the map keeps running."""
         return self.map_async(func, iterable, chunksize,
-                              priority=priority, job_id=job_id).get()
+                              priority=priority, job_id=job_id,
+                              budget=budget).get()
 
     def map_async(
         self,
@@ -2384,10 +2711,11 @@ class Pool:
         error_callback: Optional[Callable] = None,
         priority: float = 1.0,
         job_id: Optional[str] = None,
+        budget: Optional[CostBudget] = None,
     ):
         return self._dispatch_async(func, list(iterable), False, chunksize,
                                     callback, error_callback, priority,
-                                    job_id=job_id)
+                                    job_id=job_id, budget=budget)
 
     def starmap(
         self,
@@ -2396,9 +2724,11 @@ class Pool:
         chunksize: Optional[int] = None,
         priority: float = 1.0,
         job_id: Optional[str] = None,
+        budget: Optional[CostBudget] = None,
     ) -> List[Any]:
         return self.starmap_async(func, iterable, chunksize,
-                                  priority=priority, job_id=job_id).get()
+                                  priority=priority, job_id=job_id,
+                                  budget=budget).get()
 
     def starmap_async(
         self,
@@ -2409,11 +2739,12 @@ class Pool:
         error_callback: Optional[Callable] = None,
         priority: float = 1.0,
         job_id: Optional[str] = None,
+        budget: Optional[CostBudget] = None,
     ):
         return self._dispatch_async(func, [tuple(t) for t in iterable],
                                     True, chunksize, callback,
                                     error_callback, priority,
-                                    job_id=job_id)
+                                    job_id=job_id, budget=budget)
 
     def imap(
         self,
@@ -2422,13 +2753,15 @@ class Pool:
         chunksize: Optional[int] = None,
         priority: float = 1.0,
         job_id: Optional[str] = None,
+        budget: Optional[CostBudget] = None,
     ):
         items = list(iterable)
         device_out = self._device_dispatch(func, items, star=False)
         if device_out is not None:
             return iter(device_out)
         res = self._submit(func, items, chunksize, False,
-                           priority=priority, job_id=job_id)
+                           priority=priority, job_id=job_id,
+                           budget=budget)
         return _ResultIterator(self._store.iter_ordered(res._seq))
 
     def imap_unordered(
@@ -2438,13 +2771,15 @@ class Pool:
         chunksize: Optional[int] = None,
         priority: float = 1.0,
         job_id: Optional[str] = None,
+        budget: Optional[CostBudget] = None,
     ):
         items = list(iterable)
         device_out = self._device_dispatch(func, items, star=False)
         if device_out is not None:
             return iter(device_out)
         res = self._submit(func, items, chunksize, False,
-                           priority=priority, job_id=job_id)
+                           priority=priority, job_id=job_id,
+                           budget=budget)
         return _ResultIterator(self._store.iter_unordered(res._seq))
 
     # -- lifecycle ---------------------------------------------------------
@@ -2788,7 +3123,9 @@ class ResilientPool(Pool):
 
         def reply_exit(chan) -> None:
             try:
-                self._task_ep.reply(chan, serialization.dumps(_EXIT))
+                payload = serialization.dumps(_EXIT)
+                self._task_ep.reply(chan, payload)
+                self._bill_frame(None, tx=len(payload))
             except (TransportClosed, OSError):
                 pass
 
@@ -2833,6 +3170,8 @@ class ResilientPool(Pool):
                 self._task_ep.reply(chan, payload)
                 global_timer.add("pool.dispatch",
                                  time.perf_counter() - t0)
+                self._bill_frame(key[0], tx=len(payload),
+                                 dispatch_s=time.perf_counter() - t0)
                 _m_chunks_dispatched.inc()
                 if FLIGHT.enabled:
                     FLIGHT.record("pool", "dispatch", seq=key[0],
@@ -2883,6 +3222,9 @@ class ResilientPool(Pool):
                 continue
             except (TransportClosed, OSError):
                 return
+            # Handout-control traffic no single map causes: the
+            # explicit overhead bucket, never silently dropped.
+            self._bill_frame(None, rx=len(req))
             msg = serialization.loads(req)
             if msg[0] != "ready":
                 continue

@@ -128,6 +128,11 @@ class LocalStore:
             "ram_hits": 0, "disk_hits": 0, "misses": 0,
             "evictions": 0, "spills": 0, "spill_bytes": 0,
             "disk_corrupt": 0,
+            # Accounting plane (docs/observability.md "Resource
+            # accounting"): bytes currently hard-pinned by in-flight
+            # transfers, and the high-water mark — the store's
+            # contribution to a cost report's memory story.
+            "pinned_bytes": 0, "peak_pinned_bytes": 0,
         }
 
     # -- paths ----------------------------------------------------------
@@ -193,6 +198,7 @@ class LocalStore:
                 self._entries.move_to_end(digest)
                 if pin:
                     entry.pins += 1
+                    self._note_pin_locked(len(entry.data))
                 self._stats["ram_hits"] += 1
                 return entry.data
         data = self._read_disk(digest)
@@ -210,6 +216,7 @@ class LocalStore:
                 self._evict_locked(protect=digest)
             if pin:
                 entry.pins += 1
+                self._note_pin_locked(len(entry.data))
             return entry.data
 
     def get(self, digest: str) -> Tuple[bool, Any]:
@@ -242,11 +249,18 @@ class LocalStore:
             if entry is not None:
                 entry.refs = max(0, entry.refs - n)
 
+    def _note_pin_locked(self, nbytes: int) -> None:
+        self._stats["pinned_bytes"] += nbytes
+        if self._stats["pinned_bytes"] > self._stats["peak_pinned_bytes"]:
+            self._stats["peak_pinned_bytes"] = self._stats["pinned_bytes"]
+
     def unpin(self, digest: str) -> None:
         with self._lock:
             entry = self._entries.get(digest)
-            if entry is not None:
-                entry.pins = max(0, entry.pins - 1)
+            if entry is not None and entry.pins > 0:
+                entry.pins -= 1
+                self._stats["pinned_bytes"] = max(
+                    0, self._stats["pinned_bytes"] - len(entry.data))
 
     def delete(self, digest: str) -> None:
         """Drop an entry from RAM and disk regardless of refs (operator

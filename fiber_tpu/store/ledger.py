@@ -186,6 +186,11 @@ class MapLedger:
         self.journaled: Dict[int, Tuple[int, str]] = {}
         self.digests: set = set()
         self.chunks_journaled = 0
+        #: Disk bytes this ledger cost: journal lines (header, chunk,
+        #: done records) plus the serialized result payloads persisted
+        #: into the store's disk tier — the accounting plane's
+        #: ``ledger_bytes`` axis (docs/observability.md).
+        self.bytes_written = 0
         self._thread = threading.Thread(
             target=self._writer_loop, name="fiber-map-ledger", daemon=True)
         self._thread.start()
@@ -237,7 +242,9 @@ class MapLedger:
         rec.setdefault("kind", "map")
         rec.setdefault("v", LEDGER_VERSION)
         with self._cond:
-            self._fh.write(json.dumps(rec) + "\n")
+            line = json.dumps(rec) + "\n"
+            self._fh.write(line)
+            self.bytes_written += len(line)
             self._fh.flush()
             os.fsync(self._fh.fileno())
         FLIGHT.record("store", "ledger", job=rec.get("job_id"),
@@ -298,6 +305,7 @@ class MapLedger:
                     continue
                 with self._cond:
                     self._fh.write(line + "\n")
+                    self.bytes_written += len(line) + 1
                 wrote += 1
             with self._cond:
                 if wrote:
@@ -335,6 +343,7 @@ class MapLedger:
             self.journaled[base] = (n, digest)
             self.digests.add(digest)
             self.chunks_journaled += 1
+            self.bytes_written += len(payload)
         if self._on_chunk is not None:
             try:
                 self._on_chunk(digest)

@@ -250,6 +250,14 @@ class StoreClient:
         self._stats[key] += n
         if key == "wire_bytes":
             _m_store_bytes.inc(n, direction="fetched", side="client")
+            # Accounting plane (docs/observability.md "Resource
+            # accounting"): a wire fetch bills the map whose chunk
+            # caused it — the worker sets the ambient billing key
+            # around chunk processing; fetches outside any chunk land
+            # in the explicit overhead bucket.
+            from fiber_tpu.telemetry.accounting import COSTS
+
+            COSTS.bill_ambient(store_fetch_bytes=n)
         else:
             _m_store_ops.inc(n, op=key, side="client")
 

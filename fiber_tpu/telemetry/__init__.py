@@ -126,6 +126,12 @@ def refresh() -> None:
 
     DEVICE.configure(cfg)
     TIMESERIES.add_probe(DEVICE.update_gauges)
+    # Accounting plane (docs/observability.md "Resource accounting"):
+    # per-map/per-tenant cost attribution. Lazy import, same posture as
+    # monitor/device above.
+    from fiber_tpu.telemetry.accounting import COSTS
+
+    COSTS.configure(cfg)
 
 
 def snapshot() -> Dict[str, Any]:
@@ -161,7 +167,19 @@ def snapshot() -> Dict[str, Any]:
         "profiler_samples": PROFILER.samples,
         "sched": sched_snaps,
         "device": _device_snapshot(),
+        "costs": _cost_snapshot(),
     }
+
+
+def _cost_snapshot() -> Dict[str, Any]:
+    """Accounting-plane surface for the generic snapshot (null-safe:
+    a snapshot must never fail)."""
+    try:
+        from fiber_tpu.telemetry.accounting import COSTS
+
+        return COSTS.snapshot()
+    except Exception:  # pragma: no cover - snapshot must never fail
+        return {}
 
 
 def _device_snapshot() -> Dict[str, Any]:

@@ -1,0 +1,594 @@
+"""Accounting plane: per-map / per-tenant resource cost attribution
+(docs/observability.md "Resource accounting").
+
+Every counter the other planes export is process- or host-global — good
+for "is the cluster healthy", useless for "what did THIS job cost".
+ROADMAP item 3 (a multi-tenant ``fiber-tpu serve`` tier with quotas,
+admission control and preemption) cannot enforce limits it cannot
+measure, so this module attributes the raw signals that already exist
+(chunk timers, transport byte counters, store stats, device transfer /
+compile accounting, FLOPs) to a **billing key**::
+
+    (tenant, job_id, map_id)
+
+* ``tenant`` — the ``tenant`` config knob (one per client process;
+  ``serve`` will stamp it per connection);
+* ``job_id`` — ``Pool.map(..., job_id=...)``'s durable id when given,
+  else a synthetic ``map-<n>`` id;
+* ``map_id`` — unique per submitted map in this master process.
+
+The **billing key rides the task envelope's optional-field tail** (the
+same back-compat posture as the trace context), so workers know which
+map caused each chunk: their execute seconds, store fetches and device
+transfers bill to it, and the frames they send back (result / spans /
+prof / dev / cost) are billed by the master to the same key. Traffic
+no key can be attributed to — heartbeats, credit-less control frames,
+late frames of completed maps — lands in the explicit
+:data:`OVERHEAD_KEY` bucket, never silently dropped: the per-key wire
+bytes plus overhead always sum to the ledger's total.
+
+**Exactly-once billing semantics** (chaos-tested):
+
+* a *task* is billed to its map when its result slot fills for the
+  FIRST time (``ResultStore.fill`` dedup is the billing gate) — a
+  speculation duplicate or death/storemiss/partition resubmit re-runs
+  the chunk but never re-bills its tasks;
+* duplicate *traffic* (the resent chunk's wire bytes, the loser's
+  result frame) IS billed to the map — it was caused by the map, and
+  the wire reconciliation would not balance otherwise;
+* ``fiber-tpu resume`` bills restored chunks as ``tasks_restored``
+  (restore cost), never as executed tasks — restored + executed ==
+  total, the ledger plane's exactly-once contract.
+
+Collection mirrors the established plane pattern: workers ship
+cumulative ``("cost", …)`` frames on the result stream, the host agent
+serves a ``cost_snapshot`` op, ``TpuBackend.cluster_costs()`` sweeps it
+(LocalBackend twin), ``Pool.cost()`` merges master + workers into
+:func:`combine`-d reports, and a completed ``job_id`` map persists its
+report beside the PR-7 ledger so ``fiber-tpu cost <job_id>`` can show
+historical cost.
+
+**Soft budgets**: :class:`CostBudget` caps registered per key raise the
+``budget_exceeded`` watchdog anomaly (flight event + counter + log
+warning, edge-triggered once per map) when a running map crosses them —
+the enforcement hook ``serve`` admission control and preemption will
+later call.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from fiber_tpu import telemetry
+
+#: Where untaggable traffic bills. Explicit, never silently dropped:
+#: per-key wire bytes + overhead == the ledger total.
+OVERHEAD_KEY: Tuple[str, str, str] = ("-", "-", "overhead")
+
+#: Canonical numeric fields of a cost vector. Anything else passed to
+#: charge() raises — a typo'd field must not silently open a new axis.
+FIELDS = (
+    # master-side seconds
+    "serialize_s", "dispatch_s", "wall_s", "restore_s",
+    # worker-side seconds (chunk resolve+execute+encode wall)
+    "cpu_s",
+    # exactly-once task counts (master: first-fill; worker: executions
+    # INCLUDING duplicates — the difference is the duplicate count)
+    "tasks", "tasks_restored", "tasks_executed",
+    # wire bytes at the framing boundary (payload + 9-byte frame
+    # overhead), master-observed for the total, worker-observed kept
+    # as a per-source breakdown
+    "wire_tx", "wire_rx",
+    # object-store plane
+    "store_put_bytes", "store_fetch_bytes",
+    # durable-map ledger disk bytes
+    "ledger_bytes",
+    # device plane
+    "device_transfer_bytes", "device_transfer_s",
+    "compile_s", "flops", "device_s",
+)
+
+_FIELD_SET = frozenset(FIELDS)
+
+#: Wire size of one transport data frame carrying ``n`` payload bytes
+#: (8-byte length header + 1-byte type tag — transport/tcp.py
+#: ``_FRAME_OVERHEAD``). The accounting plane bills at this boundary so
+#: per-key sums reconcile with the Endpoint byte counters.
+FRAME_OVERHEAD = 9
+
+
+def wire_size(payload_len: int) -> int:
+    return int(payload_len) + FRAME_OVERHEAD
+
+
+def key_str(key: Tuple[str, str, str]) -> str:
+    """Stable text form of a billing key (snapshot dict keys must
+    survive pickling across the agent RPC plane and JSON dumps)."""
+    return "/".join(str(p) for p in key)
+
+
+def parse_key(text: str) -> Tuple[str, str, str]:
+    parts = str(text).split("/")
+    while len(parts) < 3:
+        parts.append("-")
+    return (parts[0], parts[1], "/".join(parts[2:]))
+
+
+# Per-job registry twins (docs/observability.md): bounded tenant/job
+# labels with completed-job series retired so a long-lived master's
+# 1000th job cannot fold live jobs into the overflow series
+# (metrics.py per-metric bound override + LRU retire).
+_JOB_LABEL_BOUND = 256
+_m_job_tasks = telemetry.REGISTRY.counter(
+    "cost_tasks_total", "Tasks billed per job (exactly-once)",
+    max_label_sets=_JOB_LABEL_BOUND)
+_m_job_cpu = telemetry.REGISTRY.counter(
+    "cost_cpu_seconds", "Worker busy-seconds billed per job",
+    max_label_sets=_JOB_LABEL_BOUND)
+_m_job_wire = telemetry.REGISTRY.counter(
+    "cost_wire_bytes", "Wire bytes billed per job (tx+rx)",
+    max_label_sets=_JOB_LABEL_BOUND)
+_m_budget_breaches = telemetry.counter(
+    "cost_budget_breaches", "CostBudget limits crossed, by field")
+
+#: Fields mirrored into the per-job registry counters at charge time.
+_JOB_METRIC_FIELDS = {
+    "tasks": _m_job_tasks,
+    "cpu_s": _m_job_cpu,
+    "wire_tx": _m_job_wire,
+    "wire_rx": _m_job_wire,
+}
+
+#: Completed-map vectors kept for late Pool.cost() reads before the
+#: oldest are dropped (a serve-tier master must not grow forever).
+MAX_RETIRED_KEYS = 512
+
+
+class CostBudget:
+    """Soft per-map resource caps (``Pool.map(..., budget=...)``).
+
+    Every limit is optional; a running map whose combined cost vector
+    crosses ANY set limit raises the ``budget_exceeded`` watchdog
+    anomaly (+ flight event) exactly once. Enforcement (kill /
+    preempt / refuse admission) is deliberately left to the caller —
+    this is the measurement hook the serve tier builds on."""
+
+    __slots__ = ("cpu_s", "wire_mb", "device_s", "wall_s", "tasks")
+
+    def __init__(self, cpu_s: Optional[float] = None,
+                 wire_mb: Optional[float] = None,
+                 device_s: Optional[float] = None,
+                 wall_s: Optional[float] = None,
+                 tasks: Optional[int] = None) -> None:
+        self.cpu_s = None if cpu_s is None else float(cpu_s)
+        self.wire_mb = None if wire_mb is None else float(wire_mb)
+        self.device_s = None if device_s is None else float(device_s)
+        self.wall_s = None if wall_s is None else float(wall_s)
+        self.tasks = None if tasks is None else int(tasks)
+
+    def violations(self, vec: Dict[str, float]) -> List[Tuple[str, float, float]]:
+        """``[(limit_name, limit, observed), ...]`` for every crossed cap."""
+        out: List[Tuple[str, float, float]] = []
+        if self.cpu_s is not None and vec.get("cpu_s", 0.0) > self.cpu_s:
+            out.append(("cpu_s", self.cpu_s, vec["cpu_s"]))
+        if self.wire_mb is not None:
+            wire = (vec.get("wire_tx", 0.0) + vec.get("wire_rx", 0.0)) \
+                / float(1 << 20)
+            if wire > self.wire_mb:
+                out.append(("wire_mb", self.wire_mb, wire))
+        if self.device_s is not None \
+                and vec.get("device_s", 0.0) > self.device_s:
+            out.append(("device_s", self.device_s, vec["device_s"]))
+        if self.wall_s is not None \
+                and vec.get("wall_s", 0.0) > self.wall_s:
+            out.append(("wall_s", self.wall_s, vec["wall_s"]))
+        if self.tasks is not None and vec.get("tasks", 0.0) > self.tasks:
+            out.append(("tasks", float(self.tasks), vec["tasks"]))
+        return out
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self.__slots__
+                if getattr(self, k) is not None}
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"CostBudget({self.as_dict()!r})"
+
+
+_ambient = threading.local()
+
+
+class CostLedger:
+    """Per-process cost attribution table: billing key -> cost vector.
+
+    One instance (:data:`COSTS`) serves masters AND workers — a worker's
+    table holds the keys of the chunks it executed and ships as the
+    cumulative ``("cost", …)`` frame; a master's table holds its own
+    observation points (serialize / dispatch / wire / fill) and merges
+    the workers' on top in :meth:`report`. Near-zero when disabled
+    (``accounting_enabled`` x the telemetry master switch): every hook
+    is one attribute check."""
+
+    def __init__(self) -> None:
+        self.enabled = True
+        self.tenant = "default"
+        self._lock = threading.Lock()
+        self._costs: Dict[Tuple[str, str, str], Dict[str, float]] = {}
+        self._retired: List[Tuple[str, str, str]] = []
+        #: Keys already released (map completed): late charges — the
+        #: final chunk's task bill, a trailing worker frame — still
+        #: land in the vector, but their metric series must stay
+        #: retired or every completed job would leak one label slot.
+        self._released: set = set()
+        #: Bumped on every charge — workers ship a fresh cost frame on
+        #: the result stream only when this moved (the device-plane
+        #: revision posture).
+        self.revision = 0
+        # soft budgets: key -> (CostBudget, on_breach callable or None)
+        self._budgets: Dict[Tuple[str, str, str], CostBudget] = {}
+        self._breached: Dict[Tuple[str, str, str], List[str]] = {}
+
+    # -- configuration --------------------------------------------------
+    def configure(self, cfg) -> None:
+        """Follow the config knobs (telemetry.refresh)."""
+        self.enabled = bool(cfg.telemetry_enabled) \
+            and bool(cfg.accounting_enabled)
+        self.tenant = str(cfg.tenant or "default")
+
+    # -- ambient billing context ---------------------------------------
+    def context(self, key: Optional[Tuple[str, str, str]]):
+        """Set the ambient billing key for this thread — store fetches
+        and device transfers inside the block bill to ``key`` instead of
+        overhead (the worker wraps each chunk's processing in the
+        chunk's envelope key)."""
+        return _AmbientContext(key)
+
+    @staticmethod
+    def ambient_key() -> Optional[Tuple[str, str, str]]:
+        return getattr(_ambient, "key", None)
+
+    def bill_ambient(self, **fields: float) -> None:
+        """Charge the thread's ambient key, or overhead when none is
+        set — the hook store/device planes call without knowing about
+        maps."""
+        if not self.enabled:
+            return
+        self.charge(self.ambient_key() or OVERHEAD_KEY, **fields)
+
+    # -- write side -----------------------------------------------------
+    def charge(self, key: Optional[Tuple[str, str, str]],
+               **fields: float) -> None:
+        """Accumulate ``fields`` into ``key``'s vector (None key bills
+        overhead). Unknown fields raise — the vector axes are closed."""
+        if not self.enabled:
+            return
+        key = tuple(key) if key else OVERHEAD_KEY
+        bad = set(fields) - _FIELD_SET
+        if bad:
+            raise ValueError(f"unknown cost field(s): {sorted(bad)}")
+        with self._lock:
+            vec = self._costs.get(key)
+            if vec is None:
+                vec = self._costs[key] = {}
+            for field, n in fields.items():
+                vec[field] = vec.get(field, 0.0) + float(n)
+            self.revision += 1
+            budget = self._budgets.get(key)
+            released = key in self._released
+        if key is not OVERHEAD_KEY and key[2] != "overhead":
+            for field, n in fields.items():
+                metric = _JOB_METRIC_FIELDS.get(field)
+                if metric is not None:
+                    metric.inc(float(n), tenant=key[0], job=key[1])
+            if released:
+                # A late charge re-lives the series; re-retire so the
+                # completed job's label slots stay reclaimable.
+                telemetry.REGISTRY.retire_series(tenant=key[0],
+                                                 job=key[1])
+        if budget is not None:
+            self.check_budget(key)
+
+    # -- soft budgets ---------------------------------------------------
+    def set_budget(self, key: Tuple[str, str, str],
+                   budget: CostBudget) -> None:
+        with self._lock:
+            self._budgets[tuple(key)] = budget
+
+    def check_budget(self, key: Tuple[str, str, str],
+                     extra: Optional[Dict[str, float]] = None) -> bool:
+        """Evaluate ``key``'s budget against its vector (plus ``extra``
+        — e.g. the worker-merged view the master computes). A newly
+        crossed limit raises the edge-triggered ``budget_exceeded``
+        anomaly; returns True when any limit is (or was) crossed."""
+        key = tuple(key)
+        with self._lock:
+            budget = self._budgets.get(key)
+            if budget is None:
+                return bool(self._breached.get(key))
+            vec = dict(self._costs.get(key) or {})
+            already = self._breached.setdefault(key, [])
+        if extra:
+            for field, n in extra.items():
+                vec[field] = vec.get(field, 0.0) + float(n)
+        new = [v for v in budget.violations(vec) if v[0] not in already]
+        for limit_name, limit, observed in new:
+            already.append(limit_name)
+            _m_budget_breaches.inc(field=limit_name)
+            self._raise_budget_anomaly(key, limit_name, limit, observed)
+        return bool(already)
+
+    def _raise_budget_anomaly(self, key, limit_name: str,
+                              limit: float, observed: float) -> None:
+        # Lazy import keeps the module graph acyclic (monitor registers
+        # instruments against telemetry, which imports this module).
+        from fiber_tpu.telemetry.monitor import WATCHDOG
+
+        WATCHDOG.external_breach(
+            "budget_exceeded",
+            detail=(f"map {key_str(key)} crossed its {limit_name} "
+                    f"budget: {observed:.4g} > {limit:.4g}"),
+            key=key_str(key), limit=limit_name,
+            budget=round(float(limit), 6),
+            observed=round(float(observed), 6))
+
+    def release_key(self, key: Tuple[str, str, str]) -> None:
+        """Map completed: drop its budget state, clear a standing
+        ``budget_exceeded`` anomaly when no other budgeted map is in
+        breach, retire its per-job metric series (freeing label slots
+        for future jobs), and schedule the vector for LRU eviction.
+        The vector itself stays readable until MAX_RETIRED_KEYS more
+        maps retire — Pool.cost() after join() must still answer."""
+        key = tuple(key)
+        with self._lock:
+            self._budgets.pop(key, None)
+            was_breached = bool(self._breached.pop(key, None))
+            any_breached = any(self._breached.values())
+            self._released.add(key)
+            self._retired.append(key)
+            evict = []
+            while len(self._retired) > MAX_RETIRED_KEYS:
+                evict.append(self._retired.pop(0))
+            for old in evict:
+                self._costs.pop(old, None)
+                self._released.discard(old)
+        if was_breached and not any_breached:
+            from fiber_tpu.telemetry.monitor import WATCHDOG
+
+            WATCHDOG.external_clear("budget_exceeded")
+        telemetry.REGISTRY.retire_series(tenant=key[0], job=key[1])
+
+    # -- read side ------------------------------------------------------
+    def vector(self, key: Tuple[str, str, str]) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._costs.get(tuple(key)) or {})
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Picklable per-process surface: the payload of the worker's
+        ``("cost", …)`` frames, the agent's ``cost_snapshot`` op and
+        ``cluster_costs()``."""
+        from fiber_tpu.telemetry import tracing
+
+        with self._lock:
+            costs = {key_str(k): dict(v) for k, v in self._costs.items()}
+            breached = {key_str(k): list(v)
+                        for k, v in self._breached.items() if v}
+        return {
+            "host": tracing.host_id(),
+            "pid": os.getpid(),
+            "enabled": self.enabled,
+            "tenant": self.tenant,
+            "revision": self.revision,
+            "costs": costs,
+            "breached": breached,
+        }
+
+    def totals(self) -> Dict[str, float]:
+        """Sum over every key (overhead included) — the internal
+        reconciliation surface: per-key + overhead == this, always."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            for vec in self._costs.values():
+                for field, n in vec.items():
+                    out[field] = out.get(field, 0.0) + n
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._costs.clear()
+            self._budgets.clear()
+            self._breached.clear()
+            self._retired.clear()
+            self._released.clear()
+            self.revision = 0
+
+
+class _AmbientContext:
+    __slots__ = ("_key", "_prev")
+
+    def __init__(self, key) -> None:
+        self._key = tuple(key) if key else None
+        self._prev = None
+
+    def __enter__(self) -> "_AmbientContext":
+        self._prev = getattr(_ambient, "key", None)
+        _ambient.key = self._key
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        _ambient.key = self._prev
+
+
+#: Process-wide cost ledger (knobs follow ``accounting_enabled`` /
+#: ``tenant`` via telemetry.refresh()).
+COSTS = CostLedger()
+
+
+# ---------------------------------------------------------------------------
+# Report assembly (master + worker frames -> one CostReport)
+# ---------------------------------------------------------------------------
+
+#: Fields whose authoritative observation point is the MASTER (every
+#: pool frame passes its endpoints; worker wire counts would double-bill
+#: the same traffic and are kept as a per-source breakdown only).
+_MASTER_FIELDS = frozenset((
+    "serialize_s", "dispatch_s", "wall_s", "restore_s",
+    "tasks", "tasks_restored", "wire_tx", "wire_rx",
+    "store_put_bytes", "ledger_bytes", "device_s", "flops",
+))
+
+#: Fields whose authoritative observation point is the WORKERS.
+_WORKER_FIELDS = frozenset((
+    "cpu_s", "tasks_executed", "store_fetch_bytes",
+    "device_transfer_bytes", "device_transfer_s", "compile_s",
+))
+
+
+def combine(master: Dict[str, float],
+            workers: Dict[str, float]) -> Dict[str, float]:
+    """One total vector from the two observation points, each field
+    taken from its authoritative side (module comment above) so shared
+    traffic is never double-billed."""
+    out: Dict[str, float] = {}
+    for field, n in master.items():
+        if field in _MASTER_FIELDS:
+            out[field] = out.get(field, 0.0) + n
+    for field, n in workers.items():
+        if field in _WORKER_FIELDS:
+            out[field] = out.get(field, 0.0) + n
+    return out
+
+
+def merge_worker_costs(frames: Dict[str, Dict[str, Any]]) -> Dict[str, Dict[str, float]]:
+    """Sum the latest cumulative snapshot of every worker (label ->
+    snapshot dict) into one key_str -> vector table."""
+    merged: Dict[str, Dict[str, float]] = {}
+    for snap in frames.values():
+        for kstr, vec in (snap.get("costs") or {}).items():
+            slot = merged.setdefault(kstr, {})
+            for field, n in vec.items():
+                slot[field] = slot.get(field, 0.0) + float(n)
+    return merged
+
+
+def build_report(key: Tuple[str, str, str],
+                 master_vec: Dict[str, float],
+                 worker_vecs: Dict[str, float],
+                 budget: Optional[CostBudget] = None) -> Dict[str, Any]:
+    """One map's CostReport: the combined total plus the per-source
+    breakdown (the shape ``fiber-tpu cost`` renders and the per-job
+    record persists)."""
+    total = combine(master_vec, worker_vecs)
+    report: Dict[str, Any] = {
+        "schema": "fiber-cost-v1",
+        "tenant": key[0],
+        "job_id": key[1],
+        "map_id": key[2],
+        "key": key_str(key),
+        "total": {k: (round(v, 6) if isinstance(v, float) else v)
+                  for k, v in sorted(total.items())},
+        "master": {k: round(v, 6) for k, v in sorted(master_vec.items())},
+        "workers": {k: round(v, 6) for k, v in sorted(worker_vecs.items())},
+    }
+    if budget is not None:
+        report["budget"] = budget.as_dict()
+        report["budget_violations"] = [
+            {"limit": n, "budget": b, "observed": round(o, 6)}
+            for n, b, o in budget.violations(total)]
+    return report
+
+
+def render_report(report: Dict[str, Any]) -> str:
+    """Human-readable cost report (the ``fiber-tpu cost`` output)."""
+    total = report.get("total", {})
+    lines = [
+        f"job {report.get('job_id')}  tenant {report.get('tenant')}  "
+        f"map {report.get('map_id')}",
+        f"  tasks          {int(total.get('tasks', 0))} billed"
+        f" + {int(total.get('tasks_restored', 0))} restored"
+        f" ({int(total.get('tasks_executed', 0))} executions incl."
+        " duplicates)",
+        f"  wall           {total.get('wall_s', 0.0):.3f}s"
+        f"  (serialize {total.get('serialize_s', 0.0):.3f}s,"
+        f" dispatch {total.get('dispatch_s', 0.0):.3f}s,"
+        f" restore {total.get('restore_s', 0.0):.3f}s)",
+        f"  worker cpu     {total.get('cpu_s', 0.0):.3f}s",
+        f"  wire           tx {int(total.get('wire_tx', 0))}B"
+        f"  rx {int(total.get('wire_rx', 0))}B",
+        f"  store          put {int(total.get('store_put_bytes', 0))}B"
+        f"  fetched {int(total.get('store_fetch_bytes', 0))}B",
+        f"  ledger disk    {int(total.get('ledger_bytes', 0))}B",
+        f"  device         transfer "
+        f"{int(total.get('device_transfer_bytes', 0))}B"
+        f"/{total.get('device_transfer_s', 0.0):.3f}s"
+        f"  compile {total.get('compile_s', 0.0):.3f}s"
+        f"  device_s {total.get('device_s', 0.0):.3f}"
+        f"  flops {total.get('flops', 0.0):.3g}",
+    ]
+    violations = report.get("budget_violations") or []
+    for v in violations:
+        lines.append(f"  BUDGET EXCEEDED  {v['limit']}: "
+                     f"{v['observed']:.4g} > {v['budget']:.4g}")
+    if report.get("budget") and not violations:
+        lines.append(f"  budget         {report['budget']} (within)")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Persisted per-job cost records (beside the PR-7 ledger)
+# ---------------------------------------------------------------------------
+
+
+def cost_dir(root: Optional[str] = None) -> str:
+    """Where per-job cost records land: the ``cost_dir`` config knob,
+    or ``<staging root>/costs`` — beside ``ledger/`` so ``fiber-tpu
+    jobs`` can join them."""
+    if root is None:
+        from fiber_tpu import config
+
+        configured = str(config.get().cost_dir or "")
+        if configured:
+            return os.path.realpath(configured)
+        from fiber_tpu.host_agent import default_staging_root
+
+        root = default_staging_root()
+    return os.path.join(root, "costs")
+
+
+def _record_path(job_id: str, directory: Optional[str] = None) -> str:
+    from fiber_tpu.store.ledger import check_job_id
+
+    return os.path.join(directory or cost_dir(),
+                        f"{check_job_id(job_id)}.json")
+
+
+def write_job_record(job_id: str, report: Dict[str, Any],
+                     directory: Optional[str] = None) -> Optional[str]:
+    """Persist one job's CostReport (atomic rename; best-effort — cost
+    history must never fail a map)."""
+    import tempfile
+
+    try:
+        directory = directory or cost_dir()
+        os.makedirs(directory, exist_ok=True)
+        path = _record_path(job_id, directory)
+        record = dict(report)
+        record["ts"] = time.time()
+        fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+        with os.fdopen(fd, "w") as fh:
+            json.dump(record, fh, default=str)
+        os.replace(tmp, path)
+        return path
+    except Exception:  # noqa: BLE001 - accounting must never fail maps
+        return None
+
+
+def read_job_record(job_id: str,
+                    directory: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_record_path(job_id, directory)) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None

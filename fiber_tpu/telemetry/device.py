@@ -159,6 +159,12 @@ class DeviceTelemetry:
             self.revision += 1
         _m_transfer_seconds.observe(seconds, site=site)
         _m_transfer_bytes.observe(float(nbytes), site=site)
+        # Accounting plane: the transfer bills the map whose chunk is
+        # ambient (the worker's store_resolve path), else overhead.
+        from fiber_tpu.telemetry.accounting import COSTS
+
+        COSTS.bill_ambient(device_transfer_bytes=nbytes,
+                           device_transfer_s=seconds)
         if FLIGHT.enabled:
             FLIGHT.record("device", "transfer", site=site,
                           bytes=nbytes, s=round(seconds, 6))
@@ -207,6 +213,9 @@ class DeviceTelemetry:
             self._compile_seconds += float(duration)
             self.revision += 1
         _m_compile_seconds.inc(float(duration))
+        from fiber_tpu.telemetry.accounting import COSTS
+
+        COSTS.bill_ambient(compile_s=float(duration))
 
     def note_compile(self, fingerprint: str) -> None:
         """One compilation (or compile-cache miss) of the logical

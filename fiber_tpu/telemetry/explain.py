@@ -97,6 +97,24 @@ def load_events(path: str) -> List[Dict[str, Any]]:
     return order_events(doc)
 
 
+def load_logs(path: str, last: int = 12) -> List[str]:
+    """The log-ring tail a ``Pool.flight_dump`` artifact carries (the
+    logs pillar beside the flight events): the last ``last`` lines, or
+    ``[]`` for artifacts written before the ring existed / raw event
+    lists."""
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return []
+    if not isinstance(doc, dict):
+        return []
+    logs = doc.get("logs")
+    if not isinstance(logs, list):
+        return []
+    return [str(line) for line in logs[-max(0, int(last)):]]
+
+
 def _dominant_trace(spans: Sequence[Dict[str, Any]]) -> Optional[str]:
     counts: Dict[str, int] = {}
     for sp in spans:

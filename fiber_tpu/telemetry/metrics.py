@@ -56,19 +56,50 @@ class _Instrument:
     kind = "untyped"
 
     def __init__(self, name: str, help: str,
-                 registry: "MetricsRegistry") -> None:
+                 registry: "MetricsRegistry",
+                 max_label_sets: Optional[int] = None) -> None:
         self.name = name
         self.help = help
         self._reg = registry
         self._series: Dict[Tuple, object] = {}
+        #: Per-metric label-set bound (default MAX_LABEL_SETS). Metrics
+        #: with per-job/tenant labels register a higher bound AND retire
+        #: completed-job series, so a long job sequence never folds
+        #: LIVE jobs into the overflow series.
+        self._max = int(max_label_sets) if max_label_sets \
+            else MAX_LABEL_SETS
+        #: Retired (completed-job) keys in retirement order — the LRU
+        #: eviction pool a full metric drains before overflowing.
+        self._retired: Dict[Tuple, None] = {}
 
     def _slot(self, labels: Dict[str, str]) -> Tuple:
         """Label key for this observation, bounded (caller holds the
-        registry lock)."""
+        registry lock). A full metric first evicts its oldest RETIRED
+        series (their jobs completed; the slot is reclaimable) and only
+        folds into overflow when every live series is still live."""
         key = _label_key(labels)
-        if key not in self._series and len(self._series) >= MAX_LABEL_SETS:
-            return _OVERFLOW_KEY
+        if key in self._series:
+            self._retired.pop(key, None)  # re-observed: live again
+            return key
+        if len(self._series) >= self._max:
+            if not self._retired:
+                return _OVERFLOW_KEY
+            oldest = next(iter(self._retired))
+            del self._retired[oldest]
+            self._series.pop(oldest, None)
         return key
+
+    def _retire(self, match: Tuple[Tuple[str, str], ...]) -> int:
+        """Mark every series whose labels contain all of ``match`` as
+        retired (caller holds the registry lock); returns the count."""
+        n = 0
+        for key in self._series:
+            if key is _OVERFLOW_KEY:
+                continue
+            if all(pair in key for pair in match):
+                self._retired[key] = None
+                n += 1
+        return n
 
     def _snapshot_series(self) -> Dict[str, object]:
         return {key_to_str(k): v for k, v in self._series.items()}
@@ -124,8 +155,10 @@ class Histogram(_Instrument):
     kind = "histogram"
 
     def __init__(self, name: str, help: str, registry: "MetricsRegistry",
-                 buckets: Sequence[float] = DEFAULT_BUCKETS) -> None:
-        super().__init__(name, help, registry)
+                 buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 max_label_sets: Optional[int] = None) -> None:
+        super().__init__(name, help, registry,
+                         max_label_sets=max_label_sets)
         self.buckets = tuple(sorted(float(b) for b in buckets))
         if not self.buckets:
             raise ValueError("histogram needs at least one bucket bound")
@@ -177,15 +210,33 @@ class MetricsRegistry:
             self._metrics[name] = inst
             return inst
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get_or_create(Counter, name, help)
+    def counter(self, name: str, help: str = "",
+                max_label_sets: Optional[int] = None) -> Counter:
+        return self._get_or_create(Counter, name, help,
+                                   max_label_sets=max_label_sets)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get_or_create(Gauge, name, help)
+    def gauge(self, name: str, help: str = "",
+              max_label_sets: Optional[int] = None) -> Gauge:
+        return self._get_or_create(Gauge, name, help,
+                                   max_label_sets=max_label_sets)
 
     def histogram(self, name: str, help: str = "",
-                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
-        return self._get_or_create(Histogram, name, help, buckets=buckets)
+                  buckets: Sequence[float] = DEFAULT_BUCKETS,
+                  max_label_sets: Optional[int] = None) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets,
+                                   max_label_sets=max_label_sets)
+
+    def retire_series(self, **labels: str) -> int:
+        """Mark every series (any metric) whose labels contain all of
+        ``labels`` as retired — completed-job series become the LRU
+        eviction pool their metric drains before folding new jobs into
+        overflow. Returns the number of series marked."""
+        match = _label_key(labels)
+        if not match:
+            return 0
+        with self._lock:
+            return sum(inst._retire(match)
+                       for inst in self._metrics.values())
 
     def get(self, name: str) -> Optional[_Instrument]:
         with self._lock:
@@ -215,6 +266,7 @@ class MetricsRegistry:
         with self._lock:
             for inst in self._metrics.values():
                 inst._series.clear()
+                inst._retired.clear()
 
 
 def merge_snapshots(snapshots: Dict[str, Dict[str, dict]]) -> Dict[str, dict]:

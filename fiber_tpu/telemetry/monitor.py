@@ -30,6 +30,10 @@ store_disk_fill     the object store's disk tier is past
                     about to start failing
 tx_queue_high       egress bytes queued in the transport exceed
                     ``anomaly_tx_queue_mb`` — a peer is not draining
+budget_exceeded     a running map crossed its ``CostBudget`` caps
+                    (accounting plane; raised via
+                    :meth:`AnomalyWatchdog.external_breach` at charge
+                    time, not on the sampler tick)
 ==================  ====================================================
 """
 
@@ -209,6 +213,24 @@ class AnomalyWatchdog:
                     f"{storm.get('window_s', 0)}s"),
             fingerprint=str(storm.get("fingerprint"))[:48],
             count=int(storm.get("count", 0)))
+
+    # -- external rules -------------------------------------------------
+    def external_breach(self, rule: str, detail: str,
+                        **attrs: Any) -> None:
+        """Raise a rule owned by another plane (edge-triggered like the
+        sampler rules; re-raising an active rule only refreshes its
+        record). The accounting plane's ``budget_exceeded`` rides this:
+        budgets are checked at charge time, not on the sampler tick
+        (docs/observability.md "Resource accounting")."""
+        with self._lock:
+            if rule in self._active:
+                self._active[rule].update(attrs, detail=detail)
+                return
+            self._raise_anomaly(rule, detail, **attrs)
+
+    def external_clear(self, rule: str) -> None:
+        with self._lock:
+            self._clear_anomaly(rule)
 
     # -- read side -----------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:

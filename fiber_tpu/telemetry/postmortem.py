@@ -86,6 +86,19 @@ def stack_dump() -> str:
             return "<stack dump unavailable>"
 
 
+def _log_tail(n: int = 200) -> List[str]:
+    """The process log ring's tail (utils/logging.LogRing): the third
+    observability pillar riding the bundle beside flight events and
+    stacks — what the process was LOGGING when it died. Never fails
+    capture."""
+    try:
+        from fiber_tpu.utils.logging import LOG_RING
+
+        return LOG_RING.tail(n)
+    except Exception:  # noqa: BLE001 - the dump must never fail capture
+        return []
+
+
 def capture(reason: str, ident: Optional[str] = None,
             **extra: Any) -> Dict[str, Any]:
     """Build one bundle dict from this process's state (no I/O)."""
@@ -100,6 +113,7 @@ def capture(reason: str, ident: Optional[str] = None,
         "flight": FLIGHT.snapshot(),
         "flight_dropped": FLIGHT.dropped,
         "stacks": stack_dump(),
+        "logs": _log_tail(),
     }
     if ident is not None:
         bundle["ident"] = ident

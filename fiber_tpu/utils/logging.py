@@ -15,11 +15,17 @@ worker log files (docs/observability.md).
 
 from __future__ import annotations
 
+import collections
 import logging
 import os
 import sys
+import threading
 
 LOGGER_NAME = "fiber_tpu"
+
+#: Lines kept in the per-process log ring (each formatted line is a few
+#: hundred bytes; 512 bounds a long-lived master to ~100 KB).
+LOG_RING_CAPACITY = 512
 
 FORMAT = (
     "%(asctime)s %(levelname)s:%(processName)s(%(process)d)"
@@ -57,6 +63,54 @@ class ContextFilter(logging.Filter):
 _context_filter = ContextFilter()
 
 
+class LogRing(logging.Handler):
+    """Bounded in-memory ring of the last N formatted log lines.
+
+    The logs pillar of the observability triad: metrics and traces are
+    collected cluster-wide, but log FILES stay on their hosts — this
+    ring makes the recent tail shippable. It reuses the ContextFilter's
+    ``[host job trace]`` stamps (the filter sits on the logger, so
+    every record this handler sees carries them), and its tail rides
+    postmortem bundles and ``Pool.flight_dump`` artifacts so
+    ``fiber-tpu explain --flight`` / ``postmortem`` show what the
+    process was LOGGING next to what its planes were deciding
+    (docs/observability.md "Log ring")."""
+
+    def __init__(self, capacity: int = LOG_RING_CAPACITY) -> None:
+        super().__init__(level=logging.DEBUG)
+        self._lines: "collections.deque[str]" = collections.deque(
+            maxlen=int(capacity))
+        self._ring_lock = threading.Lock()
+        self.dropped = 0
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            line = self.format(record)
+        except Exception:  # noqa: BLE001 - logging must never raise
+            return
+        with self._ring_lock:
+            if len(self._lines) == self._lines.maxlen:
+                self.dropped += 1
+            self._lines.append(line)
+
+    def tail(self, n: int = LOG_RING_CAPACITY) -> list:
+        """Newest-last copy of the last ``n`` lines."""
+        with self._ring_lock:
+            lines = list(self._lines)
+        return lines[-max(0, int(n)):]
+
+    def clear(self) -> None:
+        with self._ring_lock:
+            self._lines.clear()
+            self.dropped = 0
+
+
+#: Process-wide log ring; (re)attached by init_logger so its tail is
+#: always collectable, whatever the file/stdout handler does.
+LOG_RING = LogRing()
+LOG_RING.setFormatter(logging.Formatter(FORMAT))
+
+
 def get_logger() -> logging.Logger:
     return logging.getLogger(LOGGER_NAME)
 
@@ -92,4 +146,8 @@ def init_logger(cfg, process_name: str | None = None) -> logging.Logger:
     if _context_filter not in logger.filters:
         logger.addFilter(_context_filter)
     logger.addHandler(handler)
+    # The log ring rides beside the file/stdout handler (init_logger
+    # removed every handler above, the ring included — its LINES
+    # survive reconfiguration because the ring object is module-global).
+    logger.addHandler(LOG_RING)
     return logger

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Bench-trajectory regression check (`make bench-check`).
+
+``bench.py --record`` appends every emitted metric line to
+``BENCH_history.jsonl`` (timestamp, git sha, bench args, metric
+payload) — the BENCH_*.json records overwrite in place, so without the
+history the perf trajectory across commits is invisible. This script
+reads the history, and for every metric whose direction is known,
+compares the LATEST recorded value against the BEST ever recorded:
+a latest value more than ``--tolerance`` (default 10%) worse than the
+best is a regression and the script exits 1, printing one line per
+finding.
+
+Unknown metrics are listed but never gated (a new bench arm must not
+fail CI until its direction is declared here).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Tuple
+
+#: metric name -> "higher" (throughput-like: bigger is better) or
+#: "lower" (overhead ratios / walls / bytes: smaller is better). Gated
+#: metrics only — per-arm raw numbers (tasks/s of one arm) swing with
+#: the box and are recorded but not gated.
+DIRECTIONS: Dict[str, str] = {
+    # telemetry / accounting overhead ratios (x vs off)
+    "pool_telemetry_overhead": "lower",
+    "pool_accounting_overhead": "lower",
+    # store data plane
+    "store_put_mb_per_sec": "higher",
+    "store_get_mb_per_sec": "higher",
+    "store_wire_fetch_mb_per_sec": "higher",
+    "store_broadcast_bytes_per_task_after": "lower",
+    # scheduler plane
+    "sched_gates": "special",          # ratio fields, see below
+    # transport plane
+    "transport_selector_vs_threads": "special",
+    # durable-map recovery
+    "recovery_gates": "special",
+    # full-stack cluster bench
+    "cluster_evals_per_sec": "higher",
+    "cluster_bytes_per_task": "lower",
+}
+
+#: "special" metrics gate named RATIO FIELDS instead of "value"
+#: (field names as emitted by bench.py's gate summary lines).
+RATIO_FIELDS: Dict[str, List[Tuple[str, str]]] = {
+    "sched_gates": [("straggler_speedup", "higher"),
+                    ("uniform_overhead", "lower")],
+    "transport_selector_vs_threads": [("value", "higher"),
+                                      ("large_ratio", "higher")],
+    "recovery_gates": [("ledger_overhead", "lower"),
+                       ("resume_ratio", "lower")],
+}
+
+
+def load_history(path: str) -> List[dict]:
+    entries = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entries.append(json.loads(line))
+                except ValueError:
+                    continue  # torn tail / hand edits: skip, don't die
+    except OSError:
+        pass
+    return entries
+
+
+def _series(entries: List[dict], metric: str,
+            field: str) -> List[Tuple[dict, float]]:
+    out = []
+    for e in entries:
+        if e.get("metric") != metric:
+            continue
+        v = e.get(field)
+        if isinstance(v, (int, float)):
+            out.append((e, float(v)))
+    return out
+
+
+def check(path: str, tolerance: float) -> int:
+    entries = load_history(path)
+    if not entries:
+        print(f"bench-check: no history at {path} — run benches with "
+              "--record first (e.g. `make bench-accounting`)")
+        return 0
+    regressions = 0
+    checked = 0
+    unknown = set()
+    pairs: List[Tuple[str, str, str]] = []
+    for metric, direction in DIRECTIONS.items():
+        if direction == "special":
+            for field, fdir in RATIO_FIELDS[metric]:
+                pairs.append((metric, field, fdir))
+        else:
+            pairs.append((metric, "value", direction))
+    for metric, field, direction in pairs:
+        series = _series(entries, metric, field)
+        if len(series) < 2:
+            continue  # nothing to compare against yet
+        checked += 1
+        values = [v for _, v in series]
+        latest_entry, latest = series[-1]
+        best = max(values[:-1]) if direction == "higher" \
+            else min(values[:-1])
+        if direction == "higher":
+            regressed = latest < best * (1.0 - tolerance)
+        else:
+            regressed = latest > best * (1.0 + tolerance)
+        label = f"{metric}.{field}" if field != "value" else metric
+        if regressed:
+            regressions += 1
+            print(f"REGRESSION {label}: latest {latest:g} "
+                  f"(sha {latest_entry.get('sha') or '?'}) vs best "
+                  f"{best:g} — worse by more than {tolerance:.0%}")
+        else:
+            print(f"ok  {label}: latest {latest:g}  best {best:g}  "
+                  f"({len(series)} recorded)")
+    for e in entries:
+        m = e.get("metric")
+        if m and m not in DIRECTIONS:
+            unknown.add(m)
+    gated_unknown = sorted(unknown)
+    if gated_unknown:
+        print(f"bench-check: {len(gated_unknown)} recorded metric(s) "
+              "have no declared direction (recorded, not gated): "
+              + ", ".join(gated_unknown[:12])
+              + ("…" if len(gated_unknown) > 12 else ""))
+    print(f"bench-check: {checked} gated series checked, "
+          f"{regressions} regression(s)")
+    return 1 if regressions else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench_check")
+    parser.add_argument("--history", default="BENCH_history.jsonl")
+    parser.add_argument("--tolerance", type=float, default=0.10,
+                        help="allowed fractional slack vs the best "
+                             "recorded value (default 10%%)")
+    args = parser.parse_args(argv)
+    return check(args.history, max(0.0, float(args.tolerance)))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

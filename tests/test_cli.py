@@ -508,6 +508,7 @@ def test_backend_discovers_agents_from_tpu_worker_hostnames(monkeypatch):
     monkeypatch.setenv("TPU_WORKER_HOSTNAMES", names)
     old = config.get().tpu_hosts
     config.get().update(tpu_hosts="")
+    backend = None
     try:
         backend = TpuBackend()
         assert backend._hosts == [
@@ -520,6 +521,13 @@ def test_backend_discovers_agents_from_tpu_worker_hostnames(monkeypatch):
         assert "pod-ok" in backend.get_job_logs(job)
     finally:
         config.get().update(tpu_hosts=old)
+        if backend is not None:
+            # Stop the health-plane prober/detector too: a leaked
+            # prober keeps pinging these (stopped-listener but
+            # live-connection) embedded agents ~2/s for the REST of
+            # the suite — burning CPU and making any later test that
+            # compares agent_ops counters across two reads racy.
+            backend.shutdown_sim_cluster()
         for a in agents:
             a.stop()
 

@@ -5,7 +5,7 @@ PYTEST ?= python -m pytest tests/ -q
 
 .PHONY: test stest test-all lint bench bench-store bench-telemetry \
 	bench-sched bench-transport bench-cluster bench-recovery \
-	bench-accounting bench-check weakscale docs chaos
+	bench-accounting bench-check bench-scale weakscale docs chaos
 
 # Tier 1: local backend (subprocess jobs)
 test:
@@ -34,6 +34,8 @@ chaos:
 	FIBER_CHAOS_SEED=101 python -m pytest tests/test_chaos.py -q
 	FIBER_CHAOS_SEED=202 python -m pytest tests/test_chaos.py -q
 	FIBER_CHAOS_SEED=303 python -m pytest tests/test_chaos.py -q
+	FIBER_CHAOS_SEED=404 FIBER_TRANSPORT_IO=shm \
+		python -m pytest tests/test_chaos.py -q
 
 # FIBER_BENCH_ENFORCE: fail loudly when the 1 ms host-pool point
 # drifts past its budget (the driver's plain `python bench.py` only
@@ -92,6 +94,16 @@ bench-sched:
 bench-transport:
 	JAX_PLATFORMS=cpu python bench.py --transport --record > BENCH_transport.json; \
 	rc=$$?; cat BENCH_transport.json; exit $$rc
+
+# Master scale-out gate (docs/transport.md, docs/architecture.md):
+# a million tiny tasks through hierarchical per-host dispatch + shm
+# transport vs the recorded single-master selector baseline. FAILS
+# when master dispatch capacity (tasks per master-CPU-second) falls
+# under 3x the baseline or master CPU-seconds-per-task exceeds 0.5x.
+# The record lands in BENCH_scale.json either way.
+bench-scale:
+	JAX_PLATFORMS=cpu python bench.py --scale --record > BENCH_scale.json; \
+	rc=$$?; cat BENCH_scale.json; exit $$rc
 
 # Full-stack macro bench (docs/observability.md, ROADMAP item 5): the
 # whole stack at once — simulated multi-host pod, 8MB per-generation

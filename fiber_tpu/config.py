@@ -73,6 +73,18 @@ DEFAULTS: Dict[str, Any] = {
     # service time (with spare workers idle and the queue drained) is
     # speculated.
     "speculation_quantile": 4.0,
+    # --- hierarchical dispatch (docs/architecture.md) ---
+    # "direct": the master hands one chunk per worker request (the
+    # reference shape). "hier": packed workers (cpu_per_job > 1,
+    # ResilientPool) promote their packing parent to a per-host
+    # sub-master — the master hands out whole chunk RANGES (one frame,
+    # encoded once) and the sub-master fans individual chunks to its
+    # local workers and streams results back aggregated, so master
+    # frame count and encode CPU scale with hosts rather than workers.
+    # A sub-master death degrades respawned hosts to "direct".
+    "dispatch_mode": "direct",
+    # Upper bound on chunks handed out per range frame in "hier" mode.
+    "dispatch_range_chunks": 16,
     # --- data plane ---
     "use_push_queue": True,
     # --- transport I/O core (docs/transport.md) ---
@@ -81,7 +93,16 @@ DEFAULTS: Dict[str, Any] = {
     # scatter-gather (sendmsg) sends, small-frame coalescing; socket
     # threads are O(1) in connection count. "threads": the blocking
     # thread-per-connection fallback (one reader thread per channel).
+    # "shm": same-host zero-copy — each connection auto-negotiates a
+    # pair of mmap'd ring buffers when both peers share a host key
+    # (frames move through /dev/shm with one copy per side) and falls
+    # back to plain TCP otherwise; counters and chaos semantics are
+    # identical across all three engines (docs/transport.md).
     "transport_io": "selector",
+    # Per-direction shm ring capacity in KiB (transport_io="shm"). Each
+    # negotiated channel maps two rings of this size; frames larger
+    # than the ring stream through it in chunks.
+    "transport_shm_ring_kb": 4096,
     # Upper bound on bytes the selector loop gathers into one coalesced
     # sendmsg flush; small control frames (credit, hb, spans, storemiss)
     # queued between poller wakeups leave in a single syscall up to this

@@ -29,6 +29,13 @@ from typing import List, Optional, Union
 
 _LEN = struct.Struct(">Q")
 
+#: Wire overhead per transport frame: the 8-byte length header plus the
+#: transport's 1-byte frame-type tag. The single authority for billing —
+#: every I/O engine (threads/selector/shm) and the accounting plane's
+#: ``wire_size`` derive from this constant, so billed wire and endpoint
+#: counters can never drift apart.
+FRAME_OVERHEAD = _LEN.size + 1
+
 #: Sanity ceiling for one frame (1 TiB) — catches corrupted streams early.
 MAX_FRAME = 1 << 40
 

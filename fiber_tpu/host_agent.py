@@ -544,6 +544,8 @@ class HostAgent:
         }
 
     def _op_host_info(self) -> dict:
+        from fiber_tpu.transport import shm as shm_mod
+
         return {
             "pid": os.getpid(),
             "cpu_count": self._cores,
@@ -551,6 +553,11 @@ class HostAgent:
             "cwd": os.getcwd(),
             "python": sys.executable,
             "staging_root": self._staging_root,
+            # Same-host transport capability: whether /dev/shm backs the
+            # ring files (docs/transport.md) — tmpdir rings still work
+            # but may touch disk, which placement may care about.
+            "shm_dir": shm_mod.ring_dir(),
+            "shm_ram_backed": shm_mod.ring_dir().startswith("/dev/shm"),
         }
 
     def _op_shutdown(self) -> None:

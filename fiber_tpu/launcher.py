@@ -217,6 +217,8 @@ class JobLauncher:
         Process (so targets defined in the user's __main__ resolve)."""
         child_cfg = config.get().as_dict()
         child_cfg.update(self.backend.child_config())
+        from fiber_tpu.sched import local_host_key
+
         prep: Dict[str, Any] = {
             "fiber_config": child_cfg,
             "name": process_obj.name,
@@ -224,6 +226,10 @@ class JobLauncher:
             "sys_argv": list(sys.argv),
             "cwd": os.getcwd(),
             "authkey": bytes(process_obj.authkey or b""),
+            # The master's placement key: lets a remote worker see at
+            # bootstrap that same-host shm rings cannot engage with the
+            # master (docs/transport.md negotiation rules).
+            "master_host_key": local_host_key(),
         }
         main_path = getattr(
             sys.modules.get("__main__"), "__file__", None

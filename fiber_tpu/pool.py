@@ -626,10 +626,16 @@ def pool_worker(
     n_local: int = 1,
     ctl_addr: Optional[str] = None,
     store_addr: Optional[str] = None,
+    dispatch_mode: str = "direct",
 ) -> None:
     """Body of one pool worker process. With ``n_local > 1`` the process
     packs that many OS sub-workers, each dialing the master independently
     (reference: fiber/pool.py:144-173 cpu_per_job packing).
+
+    With ``dispatch_mode="hier"`` (resilient packed jobs only) the
+    process instead becomes this host's sub-master: it fetches chunk
+    RANGES from the master, fans them to local sub-workers, and streams
+    results back aggregated (fiber_tpu/sched/hier.py).
 
     Unlike the reference — where a dead sub-worker's pending chunks
     strand until the WHOLE job exits (job-level ``is_alive`` is the only
@@ -642,6 +648,14 @@ def pool_worker(
     reports ``("subgone", ident)`` so the master can retire the old
     ident's bookkeeping; exit 0 means the pool is draining — no respawn."""
     if n_local > 1:
+        if dispatch_mode == "hier" and resilient:
+            from fiber_tpu.sched.hier import HostDispatcher
+
+            HostDispatcher(
+                task_addr, result_addr, n_local, initializer, initargs,
+                maxtasksperchild, store_addr,
+            ).run()
+            return
         import multiprocessing
 
         from fiber_tpu.transport.tcp import connect_transport
@@ -1192,6 +1206,19 @@ class Pool:
         # Workers are packed cpu_per_job sub-workers per job, the last job
         # taking the remainder (reference: fiber/pool.py:1009-1057).
         self._cpu_per_job = max(1, int(cfg.cpu_per_job))
+        # Hierarchical dispatch (docs/architecture.md "Hierarchical
+        # dispatch"): with dispatch_mode="hier" each packed job runs a
+        # per-host sub-master that fetches whole chunk RANGES (one
+        # REQ/REP frame per range) and returns results aggregated, so
+        # master frame count scales with hosts instead of workers. Only
+        # meaningful on the resilient pool (ranges live in the pending
+        # table); packed jobs that lose their sub-master degrade to
+        # direct per-worker dispatch on respawn.
+        self._dispatch_mode = str(getattr(cfg, "dispatch_mode", "direct"))
+        self._range_chunks = max(1, int(getattr(cfg,
+                                                "dispatch_range_chunks",
+                                                16)))
+        self._hier_degraded = False
         from fiber_tpu.health import CircuitBreaker
 
         #: Health plane (fiber_tpu/health.py). The detector is armed by
@@ -1332,6 +1359,14 @@ class Pool:
     def _spawn_worker(self, n_local: int):
         from fiber_tpu.process import Process
 
+        # Hierarchical dispatch needs a packed resilient job; after a
+        # sub-master death the pool degrades new jobs to direct
+        # per-worker dispatch (_hier_degraded) — the proven path.
+        mode = ("hier" if (self._dispatch_mode == "hier"
+                           and n_local > 1
+                           and self._resilient
+                           and not self._hier_degraded)
+                else "direct")
         p = Process(
             target=pool_worker,
             args=(
@@ -1344,6 +1379,7 @@ class Pool:
                 n_local,
                 getattr(self, "_ctl_addr", None),
                 self._store_addr,
+                mode,
             ),
             name=f"PoolWorker-{uuid.uuid4().hex[:8]}",
             daemon=True,
@@ -1672,6 +1708,68 @@ class Pool:
                         detector.beat(ident)  # a report proves liveness
                     self._bill_frame(seq, rx=len(data))
                     self._on_store_miss(seq, base, n, ident)
+                    continue
+                if msg[0] == "fbatch":
+                    # Children's per-chunk telemetry ("spans"/"prof"/
+                    # "dev"/"cost"), batched by a per-host sub-master so
+                    # master ingress scales with hosts rather than
+                    # chunks. The outer frame's wire cost bills once as
+                    # overhead; the inner messages carried no wire of
+                    # their own (billed wire must still equal endpoint
+                    # counters for Pool.cost() reconciliation).
+                    _, raws, ident = msg
+                    if detector is not None:
+                        detector.beat(ident)
+                    self._bill_frame(None, rx=len(data))
+                    for raw in raws:
+                        try:
+                            inner = serialization.loads(raw)
+                            k = inner[0]
+                            if k == "spans":
+                                tracing.SPANS.add_all(inner[2])
+                            elif k == "prof":
+                                from fiber_tpu.telemetry.profiler import (
+                                    AGGREGATE)
+
+                                AGGREGATE.merge(inner[2], inner[3])
+                            elif k == "dev":
+                                self._device_workers[str(inner[2])] = (
+                                    inner[3])
+                            elif k == "cost":
+                                self._on_cost_frame(str(inner[2]),
+                                                    inner[3])
+                        except Exception:
+                            logger.exception(
+                                "pool: dropping malformed fbatch entry")
+                    continue
+                if msg[0] == "rbatch":
+                    # Aggregated results from a per-host sub-master
+                    # (hierarchical dispatch): one frame, many chunks.
+                    # Billed ONCE against the first chunk's map — billed
+                    # wire must equal actual wire for Pool.cost()
+                    # reconciliation.
+                    _, entries, ident = msg
+                    if detector is not None:
+                        detector.beat(ident)
+                    self._bill_frame(entries[0][0] if entries else None,
+                                     rx=len(data))
+                    for seq, base, values in entries:
+                        if any(isinstance(v, ObjectRef) for v in values):
+                            with global_timer.section(
+                                    "pool.store_resolve"):
+                                values = self._resolve_result_refs(
+                                    values)
+                        self._n_completed += len(values)
+                        _m_tasks_completed.inc(len(values))
+                        self._on_result(seq, base, values, ident)
+                        if self._ledgers:
+                            self._journal_chunk(seq, base, values)
+                        bill_key = (self._seq_bill.get(seq)
+                                    if COSTS.enabled else None)
+                        newly = self._store.fill(seq, base, values)
+                        if newly and bill_key is not None:
+                            COSTS.charge(bill_key, tasks=newly)
+                    _g_inflight.set(self._store.outstanding())
                     continue
                 if msg[0] != "result":
                     continue
@@ -2789,7 +2887,14 @@ class Pool:
         (reference: fiber/pool.py:1405-1422). Starts the (normally lazy)
         worker population if needed."""
         self._start_worker_thread()
-        n = n if n is not None else self._n_workers
+        if n is None:
+            n = self._n_workers
+            if (self._dispatch_mode == "hier" and self._resilient
+                    and self._cpu_per_job > 1
+                    and not self._hier_degraded):
+                # Hierarchical dispatch: one upstream result connection
+                # per sub-master JOB, not per sub-worker.
+                n = -(-self._n_workers // self._cpu_per_job)
         return self._result_ep.wait_for_peers(n, timeout)
 
     def close(self) -> None:
@@ -2930,6 +3035,9 @@ class ResilientPool(Pool):
         self._dead_idents: set = set()
         self._dead_idents_order: "deque[bytes]" = deque(maxlen=4096)
         self._pending_lock = threading.Lock()
+        #: Idents that declared themselves sub-masters ("hier" 5th field
+        #: on their ready frames): their handouts are packed into ranges.
+        self._hier_idents: set = set()
         super().__init__(*args, **kwargs)
         # Health plane: workers beat on the result stream; silence past
         # suspect_timeout declares the ident dead and reclaims its
@@ -3154,42 +3262,81 @@ class ResilientPool(Pool):
                     # handing it out would burn workers on a map whose
                     # error already surfaced.
                     item = None
-            payload, key = item
+            items = [item]
+            if ident in self._hier_idents and self._range_chunks > 1:
+                # Hierarchical handout: top the range up with whatever
+                # else is immediately available (never blocking — the
+                # first chunk already waited its turn), bounded by the
+                # knob. One frame then carries the whole range, so the
+                # master's frame count and encode CPU scale with hosts.
+                while len(items) < self._range_chunks:
+                    try:
+                        extra = self._taskq.get_for(ident, host,
+                                                    timeout=0)
+                    except pyqueue.Empty:
+                        break
+                    if extra is None:
+                        break
+                    if self._store.is_done(extra[1][0]):
+                        continue
+                    items.append(extra)
             with self._pending_lock:
                 # The worker may have been reaped while we waited for a
                 # task — its pending table is gone and nobody would
-                # ever resubmit this chunk. Requeue for the next
+                # ever resubmit these chunks. Requeue for the next
                 # "ready".
                 if (fiber_pid in self._reaped_pids
                         or ident in self._dead_idents):
-                    self._taskq.put(item)
+                    for it in items:
+                        self._taskq.put(it)
                     return
-                self._pending.setdefault(ident, {})[key] = payload
+                table = self._pending.setdefault(ident, {})
+                for payload, key in items:
+                    table[key] = payload
+            if len(items) == 1 and ident not in self._hier_idents:
+                wire = items[0][0]
+            else:
+                # Range envelope: raw chunk payloads ride untouched
+                # (encoded once at submit; the sub-master never decodes
+                # them), tagged with their pending keys.
+                wire = serialization.dumps(
+                    ("range", [(key[0], key[1], payload)
+                               for payload, key in items]))
+                self._sched.note_range(len(items))
+            first_key = items[0][1]
             try:
                 t0 = time.perf_counter()
-                self._task_ep.reply(chan, payload)
+                self._task_ep.reply(chan, wire)
                 global_timer.add("pool.dispatch",
                                  time.perf_counter() - t0)
-                self._bill_frame(key[0], tx=len(payload),
+                # One billed frame for the whole range: billed wire
+                # must equal actual wire (Pool.cost() reconciliation).
+                self._bill_frame(first_key[0], tx=len(wire),
                                  dispatch_s=time.perf_counter() - t0)
-                _m_chunks_dispatched.inc()
+                _m_chunks_dispatched.inc(len(items))
                 if FLIGHT.enabled:
-                    FLIGHT.record("pool", "dispatch", seq=key[0],
-                                  base=key[1], ident=ident.hex()[:8])
+                    FLIGHT.record("pool", "dispatch", seq=first_key[0],
+                                  base=first_key[1],
+                                  ident=ident.hex()[:8],
+                                  chunks=len(items))
                 _g_queue_depth.set(self._taskq.qsize())
                 # Service-time clock starts at the successful handout;
-                # the speculation monitor ages this entry.
-                self._sched.dispatched(key, ident, host, payload)
+                # the speculation monitor ages these entries.
+                for payload, key in items:
+                    self._sched.dispatched(key, ident, host, payload)
             except (TransportClosed, OSError):
                 # Requester died between asking and receiving; put the
-                # chunk back for the next "ready" and keep serving.
-                # Counted as a resubmission: same cause (worker death),
+                # chunks back for the next "ready" and keep serving.
+                # Counted as resubmissions: same cause (worker death),
                 # different observation path than the pending reclaim.
                 with self._pending_lock:
-                    self._pending.get(ident, {}).pop(key, None)
-                self._taskq.put(item)
-                self._n_resubmitted += 1
-                _m_chunks_resubmitted.inc()
+                    table = self._pending.get(ident, {})
+                    for _, key in items:
+                        table.pop(key, None)
+                for it in items:
+                    self._taskq.put(it)
+                self._n_resubmitted += len(items)
+                _m_chunks_resubmitted.inc(len(items))
 
         while True:
             # Re-evaluate parked requests first: results arriving or
@@ -3231,9 +3378,13 @@ class ResilientPool(Pool):
             ident, fiber_pid = msg[1], msg[2]
             # 3-tuple readys predate the scheduler plane; the placement
             # host key rides as an optional 4th field (same back-compat
-            # posture as the task envelope's trace context).
+            # posture as the task envelope's trace context). A 5th field
+            # of "hier" marks a per-host sub-master, whose handouts are
+            # packed into chunk ranges.
             if len(msg) > 3:
                 self._ident_hosts[ident] = msg[3]
+            if len(msg) > 4 and msg[4] == "hier":
+                self._hier_idents.add(ident)
             # A stale "ready" from a worker that was already reaped must
             # not receive (and thereby strand) a task: its pending table is
             # gone and nobody would ever resubmit the chunk. Same for an
@@ -3412,6 +3563,21 @@ class ResilientPool(Pool):
         poison-counting reclaim as sub-worker death, so a chunk that
         kills whole workers escalates identically."""
         pid = proc.pid
+        if (getattr(proc, "_n_local", 1) > 1
+                and self._dispatch_mode == "hier"
+                and not self._hier_degraded):
+            # A dead packed job under hierarchical dispatch was a
+            # sub-master. Its pending range is reclaimed below like any
+            # death, but the REPLACEMENT jobs run direct per-worker
+            # dispatch: repeated sub-master loss must converge on the
+            # proven path, not crash-loop the hierarchy.
+            self._hier_degraded = True
+            logger.warning(
+                "hier: sub-master job %s died; degrading this pool to "
+                "direct per-worker dispatch", proc.name)
+            FLIGHT.record("hier", "degrade", job=proc.name,
+                          reason="sub-master death; respawns use "
+                                 "direct dispatch")
         with self._pending_lock:
             self._reaped_pids.add(pid)
             idents = self._pid_to_idents.pop(pid, set())

@@ -57,7 +57,8 @@ logger = get_logger()
 # Pool.metrics() / the Prometheus endpoint instead of being folklore.
 _m_decisions = telemetry.counter(
     "sched_decisions",
-    "Scheduler policy decisions, by kind (locality|speculate|fair)")
+    "Scheduler policy decisions, by kind "
+    "(locality|speculate|fair|range)")
 _h_chunk_duration = telemetry.histogram(
     "pool_chunk_duration_seconds",
     "Chunk service time, handout to result arrival, seconds")
@@ -176,7 +177,7 @@ class Scheduler:
         #: exact per-pool decision counts (the registry twins aggregate
         #: across pools; tests and Pool.stats() read these).
         self.decisions: Dict[str, int] = {
-            "locality": 0, "speculate": 0, "fair": 0}
+            "locality": 0, "speculate": 0, "fair": 0, "range": 0}
         self._spec_stop = threading.Event()
         self._spec_thread: Optional[threading.Thread] = None
         if self.speculation:
@@ -308,6 +309,18 @@ class Scheduler:
                 # never correctness.
                 known.clear()
             known.update(digests)
+
+    def note_range(self, n_chunks: int) -> None:
+        """Count one hierarchical-dispatch range handout (``n_chunks``
+        chunks left in ONE frame to a per-host sub-master instead of
+        ``n_chunks`` frames to individual workers — docs/scheduling.md,
+        docs/architecture.md hierarchical dispatch)."""
+        self.decisions["range"] = self.decisions.get("range", 0) + 1
+        _m_decisions.inc(kind="range")
+        if FLIGHT.enabled:
+            FLIGHT.record("sched", "range", chunks=n_chunks,
+                          reason="hierarchical handout: one frame, "
+                                 f"{n_chunks} chunk(s)")
 
     # -- dispatch lifecycle (pool serve/result/reclaim hooks) ------------
     def dispatched(self, key: Tuple[int, int], ident: bytes,

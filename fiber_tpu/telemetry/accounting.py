@@ -94,11 +94,11 @@ FIELDS = (
 
 _FIELD_SET = frozenset(FIELDS)
 
-#: Wire size of one transport data frame carrying ``n`` payload bytes
-#: (8-byte length header + 1-byte type tag — transport/tcp.py
-#: ``_FRAME_OVERHEAD``). The accounting plane bills at this boundary so
-#: per-key sums reconcile with the Endpoint byte counters.
-FRAME_OVERHEAD = 9
+#: Wire size of one transport data frame carrying ``n`` payload bytes.
+#: Re-exported from framing.FRAME_OVERHEAD (the single authority every
+#: I/O engine bills through) so per-key sums reconcile with the
+#: Endpoint byte counters under threads, selector and shm alike.
+from fiber_tpu.framing import FRAME_OVERHEAD  # noqa: E402
 
 
 def wire_size(payload_len: int) -> int:

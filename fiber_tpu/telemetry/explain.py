@@ -43,7 +43,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 #: blame — it appears in the budget but never as primary unless nothing
 #: else has weight).
 CATEGORIES = ("straggler", "transfer", "store_fetch", "locality_miss",
-              "backpressure", "transport_stall")
+              "backpressure", "transport_stall", "fanout")
 
 
 def spans_from_chrome(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
@@ -239,6 +239,16 @@ def explain_trace(spans: Sequence[Dict[str, Any]],
         float(ev.get("stall_s", 0.0)) for ev in scoped
         if ev.get("plane") == "transport"
         and ev.get("kind") in ("stall", "park"))
+    # Hierarchical dispatch: seconds a per-host sub-master spent
+    # blocked feeding its local sub-workers (sched/hier.py records a
+    # fanout_stall per blocked feed) — the range handout outran the
+    # host's compute, so the fan-out itself is the bottleneck.
+    fanout_stalls = [ev for ev in scoped
+                     if ev.get("plane") == "hier"
+                     and ev.get("kind") == "fanout_stall"]
+    budget["fanout"] = sum(float(ev.get("wait_s", 0.0))
+                           for ev in fanout_stalls)
+    evidence["fanout"] = {"stalls": len(fanout_stalls)}
 
     ranked = sorted(((c, budget[c]) for c in CATEGORIES),
                     key=lambda kv: kv[1], reverse=True)

@@ -1,0 +1,474 @@
+"""Same-host shared-memory transport engine (``transport_io="shm"``).
+
+One negotiated connection owns a pair of mmap'd SPSC ring buffers (one
+per direction) backed by files in ``/dev/shm`` (tempdir fallback). The
+rings carry the exact TCP wire format — 8-byte big-endian length header
+plus the transport's 1-byte frame-type tag — so the shared ingress
+(``_Channel.handle_frame``) and the frame decoder (``framing.
+FrameBuffer``) run unchanged: a ring quacks like a non-blocking socket
+(``recv``/``recv_into`` raising ``BlockingIOError`` when empty). Large
+payloads are written into the ring as one copy and read out of it with
+``recv_into`` directly into the frame buffer — one copy per side,
+instead of the four a loopback TCP hop costs (pickle→send→recv→
+unpickle staging buffers).
+
+Negotiation (docs/transport.md) is strictly sequential on the freshly
+authenticated TCP socket, BEFORE any data frame:
+
+* the dialer creates both rings, stamps a random token in each header,
+  and sends one hello frame (paths + tokens + capacity + its host key);
+* the binder attaches the rings only when the host keys match and the
+  tokens verify, then answers ACK (go shm) or NAK (stay TCP);
+* any non-handshake first frame means the peer speaks plain TCP — it is
+  handed back to the caller as ``leftover`` and injected through
+  ``handle_frame`` so no wire frame is ever lost;
+* a timeout on either side falls back to TCP. Handshake frames all
+  start with the ``0x02`` type byte, which ``handle_frame`` drops
+  silently, so a timed-out race can never corrupt the data stream.
+
+The TCP socket stays open beside the rings: it detects peer death (EOF)
+and heals the one pathological race (binder ACKed but the dialer timed
+out) because the shm read loop decodes stray TCP frames through the
+same ingress. It is also the *doorbell*: an idle reader raises a
+waiting flag in its rx ring header and parks in ``select()`` on the
+socket; a writer whose write found the ring empty (or the flag up)
+sends one tiny ``0x02`` wake frame. No spinning while idle — the cost
+of a wakeup is one 9-byte loopback send, paid only on empty→non-empty
+transitions, and a short select timeout bounds the one cross-process
+store/load reordering window a flag-based handoff cannot close.
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import os
+import struct
+import tempfile
+import time
+from typing import Optional, Tuple
+
+from fiber_tpu import telemetry
+from fiber_tpu.framing import recv_frame_timeout, send_frame
+from fiber_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+# Registry twins for the shm engine (docs/observability.md): the
+# engine-agnostic transport_* counters still cover every frame; these
+# isolate the shm share so operators can see negotiation win/loss and
+# ring throughput directly.
+_m_shm_bytes_tx = telemetry.counter(
+    "transport_shm_bytes_tx",
+    "Wire bytes written into shm rings (framing headers included)")
+_m_shm_bytes_rx = telemetry.counter(
+    "transport_shm_bytes_rx",
+    "Wire bytes read out of shm rings (framing headers included)")
+_m_shm_frames_tx = telemetry.counter(
+    "transport_shm_frames_tx", "Frames written into shm rings")
+_m_shm_frames_rx = telemetry.counter(
+    "transport_shm_frames_rx", "Frames read out of shm rings")
+_m_shm_channels = telemetry.counter(
+    "transport_shm_channels", "Connections negotiated onto shm rings")
+_m_shm_fallbacks = telemetry.counter(
+    "transport_shm_fallbacks",
+    "shm negotiations that fell back to plain TCP")
+_m_shm_backpressure = telemetry.counter(
+    "transport_shm_ring_full_waits",
+    "Ring writes that blocked on a full ring (backpressure)")
+
+#: Ring file layout: a 64-byte header, then the data area.
+#: [0:8]   write_pos — free-running uint64, writer-owned
+#: [8:16]  read_pos  — free-running uint64, reader-owned
+#: [16:24] capacity  — data-area bytes
+#: [24:40] token     — 16 random bytes; the attach-side proof that the
+#:                     file is the one the hello named (a stale path
+#:                     reused by another process fails verification)
+#: [40]    waiting   — reader-owned doorbell flag: 1 while the reader
+#:                     is parked in select() on the companion socket
+HEADER_SIZE = 64
+_POS = struct.Struct("<Q")
+_CAP_OFF = 16
+_TOKEN_OFF = 24
+_TOKEN_LEN = 16
+_WAIT_OFF = 40
+
+#: First byte of every handshake frame — the transport's 0x02 frame
+#: type, which _Channel.handle_frame drops silently so a timed-out
+#: handshake race cannot masquerade as data.
+MAGIC = b"\x02FIBSHM1"
+
+#: How long each side waits for the peer's handshake turn. A same-host
+#: shm peer answers in microseconds; only MIXED engine configs (one
+#: side shm, the other not) ever run the clock out, paying this once
+#: per connection before the TCP fallback.
+NEGOTIATE_TIMEOUT_S = 2.0
+
+
+def negotiate_timeout() -> float:
+    try:
+        return float(os.environ.get("FIBER_SHM_NEGOTIATE_S",
+                                    NEGOTIATE_TIMEOUT_S))
+    except ValueError:
+        return NEGOTIATE_TIMEOUT_S
+
+
+class RingClosed(OSError):
+    """The ring was closed under a blocked reader/writer (peer death or
+    endpoint shutdown)."""
+
+
+def ring_dir() -> str:
+    d = "/dev/shm"
+    if os.path.isdir(d) and os.access(d, os.W_OK):
+        return d
+    return tempfile.gettempdir()
+
+
+def ring_capacity() -> int:
+    from fiber_tpu import config
+
+    kb = int(getattr(config.get(), "transport_shm_ring_kb", 4096) or 4096)
+    # Floor keeps a misconfigured tiny ring from grinding every frame
+    # into single-byte writes; frames larger than the ring still move
+    # (write() streams them through in chunks).
+    return max(64, kb) * 1024
+
+
+class ShmRing:
+    """SPSC byte ring over one mmap'd file. Single writer process,
+    single reader process; positions are free-running so ``write_pos -
+    read_pos`` is the buffered byte count and wraparound needs no
+    modular fixups. The reader side quacks like a non-blocking socket
+    (``recv``/``recv_into`` raise ``BlockingIOError`` when empty) so
+    ``framing.FrameBuffer`` decodes it unchanged."""
+
+    __slots__ = ("_mm", "path", "capacity", "token", "_closed")
+
+    def __init__(self, mm: mmap.mmap, path: str, capacity: int,
+                 token: bytes) -> None:
+        self._mm = mm
+        self.path = path
+        self.capacity = capacity
+        self.token = token
+        self._closed = False
+
+    # -- lifecycle --------------------------------------------------------
+    @classmethod
+    def create(cls, capacity: int,
+               directory: Optional[str] = None) -> "ShmRing":
+        fd, path = tempfile.mkstemp(prefix="fiber-shm-",
+                                    dir=directory or ring_dir())
+        try:
+            os.ftruncate(fd, HEADER_SIZE + capacity)
+            mm = mmap.mmap(fd, HEADER_SIZE + capacity)
+        except BaseException:
+            os.close(fd)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            raise
+        os.close(fd)
+        token = os.urandom(_TOKEN_LEN)
+        _POS.pack_into(mm, _CAP_OFF, capacity)
+        mm[_TOKEN_OFF:_TOKEN_OFF + _TOKEN_LEN] = token
+        return cls(mm, path, capacity, token)
+
+    @classmethod
+    def attach(cls, path: str, token: bytes, capacity: int) -> "ShmRing":
+        fd = os.open(path, os.O_RDWR)
+        try:
+            mm = mmap.mmap(fd, HEADER_SIZE + capacity)
+        finally:
+            os.close(fd)
+        if (bytes(mm[_TOKEN_OFF:_TOKEN_OFF + _TOKEN_LEN]) != token
+                or _POS.unpack_from(mm, _CAP_OFF)[0] != capacity):
+            mm.close()
+            raise OSError("shm ring token/capacity mismatch")
+        return cls(mm, path, capacity, token)
+
+    def unlink(self) -> None:
+        """Remove the backing file (both sides' mmaps keep the memory
+        alive); called once the peer has attached so a crash leaves no
+        litter in /dev/shm."""
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        """Mark the ring closed: blocked writers raise RingClosed, the
+        reader raises on its next call. The mmap itself is released by
+        GC — closing it here could race a reader mid-copy."""
+        self._closed = True
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # -- positions --------------------------------------------------------
+    @property
+    def write_pos(self) -> int:
+        return _POS.unpack_from(self._mm, 0)[0]
+
+    @property
+    def read_pos(self) -> int:
+        return _POS.unpack_from(self._mm, 8)[0]
+
+    def buffered(self) -> int:
+        """Bytes written but not yet read."""
+        return self.write_pos - self.read_pos
+
+    # -- doorbell flag ----------------------------------------------------
+    def set_waiting(self) -> None:
+        """Reader side: advertise that we are about to park in select()
+        on the companion socket. The writer doorbells any write that
+        lands while the flag is up."""
+        self._mm[_WAIT_OFF] = 1
+
+    def clear_waiting(self) -> None:
+        self._mm[_WAIT_OFF] = 0
+
+    @property
+    def reader_waiting(self) -> bool:
+        """Writer side: is the peer parked (or about to park) waiting
+        for a doorbell?"""
+        return self._mm[_WAIT_OFF] != 0
+
+    # -- writer side ------------------------------------------------------
+    def write(self, data) -> bool:
+        """Append all of ``data``, blocking (yield, then sleeps growing
+        to 1 ms — a full ring means a deep backlog, not a latency-
+        critical wait) while the ring is full. Frames larger than the
+        ring stream through in capacity-bounded chunks, so a huge
+        broadcast payload can never deadlock against its own
+        backpressure. Returns True when the ring was empty at call
+        entry — the reader may have parked, so the caller should ring
+        the doorbell. Raises :class:`RingClosed` if the ring closes
+        mid-write."""
+        mv = memoryview(data)
+        if mv.nbytes == 0:
+            return False
+        if mv.ndim != 1 or mv.itemsize != 1:
+            mv = mv.cast("B")
+        mm = self._mm
+        cap = self.capacity
+        was_empty = (_POS.unpack_from(mm, 0)[0]
+                     == _POS.unpack_from(mm, 8)[0])
+        spins = 0
+        waited = False
+        while mv.nbytes:
+            if self._closed:
+                raise RingClosed("shm ring closed")
+            wp = _POS.unpack_from(mm, 0)[0]
+            free = cap - (wp - _POS.unpack_from(mm, 8)[0])
+            if free <= 0:
+                if not waited:
+                    waited = True
+                    _m_shm_backpressure.inc()
+                spins += 1
+                time.sleep(0.0 if spins < 16
+                           else min(0.001, 0.0001 * (spins - 16)))
+                continue
+            spins = 0
+            n = min(mv.nbytes, free)
+            off = wp % cap
+            first = min(n, cap - off)
+            mm[HEADER_SIZE + off:HEADER_SIZE + off + first] = mv[:first]
+            if n > first:
+                mm[HEADER_SIZE:HEADER_SIZE + n - first] = mv[first:n]
+            # Data lands before the position advances — the reader can
+            # never see bytes it isn't allowed to copy yet.
+            _POS.pack_into(mm, 0, wp + n)
+            mv = mv[n:]
+        return was_empty
+
+    # -- reader side (socket-quack for framing.FrameBuffer) ---------------
+    def recv(self, n: int) -> bytes:
+        """Up to ``n`` buffered bytes; BlockingIOError when empty (never
+        ``b""`` — EOF is the companion TCP socket's job)."""
+        if self._closed:
+            raise RingClosed("shm ring closed")
+        mm = self._mm
+        rp = _POS.unpack_from(mm, 8)[0]
+        avail = _POS.unpack_from(mm, 0)[0] - rp
+        if avail <= 0:
+            raise BlockingIOError
+        n = min(n, avail)
+        cap = self.capacity
+        off = rp % cap
+        first = min(n, cap - off)
+        out = bytes(mm[HEADER_SIZE + off:HEADER_SIZE + off + first])
+        if n > first:
+            out += bytes(mm[HEADER_SIZE:HEADER_SIZE + n - first])
+        _POS.pack_into(mm, 8, rp + n)
+        return out
+
+    def recv_into(self, view, n: Optional[int] = None) -> int:
+        """Copy up to ``n`` (default: ``len(view)``) buffered bytes into
+        ``view``; BlockingIOError when empty. The large-frame path:
+        framing.FrameBuffer fills the frame's own buffer directly from
+        the ring — the single reader-side copy."""
+        if self._closed:
+            raise RingClosed("shm ring closed")
+        mm = self._mm
+        rp = _POS.unpack_from(mm, 8)[0]
+        avail = _POS.unpack_from(mm, 0)[0] - rp
+        if avail <= 0:
+            raise BlockingIOError
+        view = memoryview(view)
+        if view.ndim != 1 or view.itemsize != 1:
+            view = view.cast("B")
+        n = view.nbytes if n is None else min(n, view.nbytes)
+        n = min(n, avail)
+        cap = self.capacity
+        off = rp % cap
+        first = min(n, cap - off)
+        view[:first] = mm[HEADER_SIZE + off:HEADER_SIZE + off + first]
+        if n > first:
+            view[first:n] = mm[HEADER_SIZE:HEADER_SIZE + n - first]
+        _POS.pack_into(mm, 8, rp + n)
+        return n
+
+
+class ShmPair:
+    """The two rings of one negotiated channel, from the owner's point
+    of view: ``tx`` is written by this process, ``rx`` read by it."""
+
+    __slots__ = ("tx", "rx")
+
+    def __init__(self, tx: ShmRing, rx: ShmRing) -> None:
+        self.tx = tx
+        self.rx = rx
+
+    def close(self) -> None:
+        self.tx.close()
+        self.rx.close()
+
+
+def _cleanup_created(*rings: ShmRing) -> None:
+    for r in rings:
+        r.close()
+        r.unlink()
+
+
+def negotiate_dialer(
+    sock,
+) -> Tuple[Optional[ShmPair], Optional[bytes]]:
+    """Dialer side of the shm handshake, run on the freshly
+    authenticated socket before any data frame. Returns ``(pair,
+    leftover)``: ``pair=None`` means stay on TCP; ``leftover`` is a
+    non-handshake frame consumed from the stream during the attempt
+    (the binder spoke plain TCP first) which the caller must inject
+    through ``handle_frame`` so no wire frame is lost."""
+    from fiber_tpu.sched import local_host_key
+
+    cap = ring_capacity()
+    try:
+        tx = ShmRing.create(cap)
+    except OSError:
+        _m_shm_fallbacks.inc()
+        return None, None
+    try:
+        rx = ShmRing.create(cap)
+    except OSError:
+        _cleanup_created(tx)
+        _m_shm_fallbacks.inc()
+        return None, None
+    hello = MAGIC + json.dumps({
+        "host": local_host_key(),
+        "tx": tx.path, "tx_token": tx.token.hex(),
+        "rx": rx.path, "rx_token": rx.token.hex(),
+        "capacity": cap,
+    }).encode()
+    try:
+        send_frame(sock, hello)
+        reply = recv_frame_timeout(sock, negotiate_timeout())
+    except OSError:
+        _cleanup_created(tx, rx)
+        _m_shm_fallbacks.inc()
+        return None, None
+    if reply is None:
+        # Timeout: the binder either isn't shm or is pathologically
+        # slow. Either way TCP is safe — a late ACK is never acted on,
+        # and a binder that DID go shm still decodes our TCP frames
+        # (its read loop drains both sources).
+        _cleanup_created(tx, rx)
+        _m_shm_fallbacks.inc()
+        return None, None
+    if not bytes(reply).startswith(MAGIC):
+        # A shm binder sends nothing before its verdict, so a non-
+        # handshake first frame proves the binder speaks plain TCP.
+        _cleanup_created(tx, rx)
+        _m_shm_fallbacks.inc()
+        leftover = bytes(reply)
+        # A stray 0x02 frame is control noise, not data — drop it.
+        return None, (None if leftover[:1] == b"\x02" else leftover)
+    try:
+        verdict = json.loads(bytes(reply[len(MAGIC):]))
+        ok = bool(verdict.get("ok"))
+    except ValueError:
+        ok = False
+    if not ok:
+        _cleanup_created(tx, rx)
+        _m_shm_fallbacks.inc()
+        return None, None
+    # Both sides are attached: the files can go — the mmaps keep the
+    # memory alive, and an unlinked ring survives any crash cleanly.
+    tx.unlink()
+    rx.unlink()
+    _m_shm_channels.inc()
+    return ShmPair(tx=tx, rx=rx), None
+
+
+def negotiate_binder(
+    sock,
+) -> Tuple[Optional[ShmPair], Optional[bytes]]:
+    """Binder side: wait for the dialer's first frame. A hello with a
+    matching host key and verifying ring tokens → attach, ACK, go shm.
+    Any other first frame → the dialer speaks plain TCP; return that
+    frame as ``leftover``. Timeout (a dialer that never speaks first,
+    e.g. a plain receive-only peer waiting for credit) → TCP."""
+    from fiber_tpu.sched import local_host_key
+
+    try:
+        first = recv_frame_timeout(sock, negotiate_timeout())
+    except OSError:
+        return None, None
+    if first is None:
+        _m_shm_fallbacks.inc()
+        return None, None
+    first = bytes(first)
+    if not first.startswith(MAGIC):
+        _m_shm_fallbacks.inc()
+        return None, (None if first[:1] == b"\x02" else first)
+    pair = None
+    try:
+        info = json.loads(first[len(MAGIC):])
+        if info.get("host") == local_host_key():
+            cap = int(info["capacity"])
+            # Reversed roles: the dialer's tx ring is our rx.
+            rx = ShmRing.attach(str(info["tx"]),
+                                bytes.fromhex(info["tx_token"]), cap)
+            try:
+                tx = ShmRing.attach(str(info["rx"]),
+                                    bytes.fromhex(info["rx_token"]), cap)
+            except OSError:
+                rx.close()
+                raise
+            pair = ShmPair(tx=tx, rx=rx)
+    except (OSError, KeyError, ValueError, TypeError):
+        pair = None
+    try:
+        send_frame(sock, MAGIC + json.dumps(
+            {"ok": pair is not None}).encode())
+    except OSError:
+        if pair is not None:
+            pair.close()
+        return None, None
+    if pair is None:
+        _m_shm_fallbacks.inc()
+        return None, None
+    _m_shm_channels.inc()
+    return pair, None

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import collections
 import itertools
+import select
 import socket as pysocket
 import struct
 import threading
@@ -29,6 +30,7 @@ from typing import List, Optional, Tuple
 from fiber_tpu import auth, config, telemetry
 from fiber_tpu.testing import chaos
 from fiber_tpu.framing import (
+    FRAME_OVERHEAD,
     SMALL_FRAME_MAX,
     ConnectionClosed,
     FrameBuffer,
@@ -73,7 +75,10 @@ _m_txq_highwater_waits = telemetry.counter(
 _txq_peak_seen = 0  # unlocked monotone max; races only under-report
 
 #: Wire overhead per frame: 8-byte length header + 1-byte type prefix.
-_FRAME_OVERHEAD = 9
+#: Aliased from framing.FRAME_OVERHEAD — the single billing authority
+#: shared with the accounting plane's ``wire_size`` — so every engine
+#: (threads/selector/shm) and every biller count the same 9 bytes.
+_FRAME_OVERHEAD = FRAME_OVERHEAD
 
 MODES = ("r", "w", "rw", "req", "rep")
 
@@ -85,6 +90,18 @@ _WAKE = object()  # recv_req nudge (Endpoint.wake), never delivered as data
 _T_DATA = b"\x00"
 _T_CREDIT = b"\x01"
 _T_CREDIT_BYTE = _T_CREDIT[0]  # int compare — no per-frame slice alloc
+# 0x02 marks shm-negotiation control frames (fiber_tpu/transport/shm.py).
+# They live strictly in the pre-data handshake; one reaching handle_frame
+# means a timed-out handshake race, and the ingress drops it silently so
+# the race can never corrupt the data stream.
+_T_SHM = b"\x02"
+_T_SHM_BYTE = _T_SHM[0]
+#: The shm doorbell: one complete 9-byte wire frame whose payload is a
+#: single 0x02 byte. A writer sends it on the companion TCP socket to
+#: wake a reader parked in select(); the shm read loop drops it before
+#: handle_frame so it never touches the wire counters (exact tx/rx
+#: parity for data frames is a billing invariant).
+_SHM_DOORBELL = pack_header(1) + _T_SHM
 _CREDIT = struct.Struct(">I")
 
 #: Standing credit window granted per peer by bound r-endpoints (fan-in
@@ -162,11 +179,18 @@ class _Channel:
 
     _ids = itertools.count()
 
-    def __init__(self, sock: pysocket.socket, owner: "Endpoint") -> None:
+    def __init__(self, sock: pysocket.socket, owner: "Endpoint",
+                 shm=None) -> None:
         self.sock = sock
         self.owner = owner
         self.cid = next(self._ids)
         self.alive = True
+        # shm engine: a negotiated ShmPair replaces the socket as the
+        # data path (the socket stays open for EOF-based peer-death
+        # detection and to heal handshake races). None = plain TCP —
+        # including the fallback channels of an endpoint whose _io is
+        # "shm" (those run the threads engine).
+        self.shm = shm
         self.credit = 0  # how many frames the peer is ready to accept
         self.replenish_owed = 0  # batched standing-window replenish
         self.last_rx: Optional[float] = None  # monotonic, any frame kind
@@ -183,7 +207,7 @@ class _Channel:
         self._send_lock = threading.Lock()
         sock.setsockopt(pysocket.IPPROTO_TCP, pysocket.TCP_NODELAY, 1)
         self._reader: Optional[threading.Thread] = None
-        self._io_selector = owner._io == "selector"
+        self._io_selector = shm is None and owner._io == "selector"
         self._loop = None
         if self._io_selector:
             from fiber_tpu.transport.evloop import get_loop
@@ -203,13 +227,14 @@ class _Channel:
             self._stall_pending = None
 
     def start_io(self) -> None:
-        """Attach the connection to its I/O engine (reader thread or
-        the selector loop)."""
+        """Attach the connection to its I/O engine (reader thread,
+        shm poll loop, or the selector loop)."""
         if self._io_selector:
             self._loop.register_channel(self)
             return
         self._reader = threading.Thread(
-            target=self._read_loop,
+            target=self._shm_read_loop if self.shm is not None
+            else self._read_loop,
             name=f"fiber-chan-{self.cid}",
             daemon=True,
         )
@@ -240,6 +265,10 @@ class _Channel:
             with self.owner._chan_lock:
                 self.credit += n
                 self.owner._chan_lock.notify_all()
+            return None
+        if frame and frame[0] == _T_SHM_BYTE:
+            # Stray shm-handshake frame (a timed-out negotiation race):
+            # control traffic, never data — counted as wire, dropped.
             return None
         # Chaos injection point (no-op unless a plan is active): bound-r
         # ingress only — REQ/REP and connected endpoints have lockstep
@@ -309,6 +338,77 @@ class _Channel:
             while True:
                 self.handle_frame(reader.recv())
         except (ConnectionClosed, OSError):
+            pass
+        finally:
+            self.owner._drop_channel(self)
+
+    def _shm_read_loop(self) -> None:
+        """shm-engine ingress: drain the rx ring through FrameBuffer
+        (the ring quacks like a non-blocking socket) and run every frame
+        through the shared handle_frame ingress — credits, chaos hook,
+        counters, inbox delivery all behave exactly as under the other
+        engines. The companion TCP socket serves three jobs: EOF is
+        peer death (the ring itself has no hangup signal); stray TCP
+        *frames* decode through the same ingress — which heals the one
+        pathological handshake race (we ACKed shm but the dialer timed
+        out onto TCP); and it carries the writer's doorbell. When both
+        sources are idle the loop raises the ring's waiting flag,
+        re-checks the ring (closes the flag-raised-too-late race), and
+        parks in select() on the socket — zero CPU while idle. Pure
+        doorbell frames (payload == 0x02, nothing else) are dropped
+        BEFORE handle_frame so they never perturb the exact wire
+        counters. The select timeout bounds the one missed-wakeup
+        window a cross-process flag handoff leaves open (store/load
+        reordering between the position advance and the flag check)."""
+        from fiber_tpu.transport.shm import (
+            _m_shm_bytes_rx, _m_shm_frames_rx)
+
+        ring = self.shm.rx
+        ring_fb = FrameBuffer()
+        sock_fb = FrameBuffer()
+        try:
+            self.sock.setblocking(False)
+        except OSError:
+            pass
+        try:
+            while self.alive:
+                progressed = False
+                try:
+                    if ring_fb.fill(ring):
+                        progressed = True
+                except BlockingIOError:
+                    pass
+                while True:
+                    frame = ring_fb.pop()
+                    if frame is None:
+                        break
+                    progressed = True
+                    _m_shm_bytes_rx.inc(len(frame) + 8)
+                    _m_shm_frames_rx.inc()
+                    self.handle_frame(frame)
+                try:
+                    if sock_fb.fill(self.sock) == 0:
+                        return  # EOF: peer is gone
+                    progressed = True
+                except (BlockingIOError, InterruptedError):
+                    pass
+                while True:
+                    frame = sock_fb.pop()
+                    if frame is None:
+                        break
+                    progressed = True
+                    if frame == _T_SHM:
+                        continue  # doorbell: we are, demonstrably, awake
+                    self.handle_frame(frame)
+                if progressed:
+                    continue
+                ring.set_waiting()
+                try:
+                    if ring.buffered() == 0:
+                        select.select([self.sock], [], [], 0.05)
+                finally:
+                    ring.clear_waiting()
+        except OSError:
             pass
         finally:
             self.owner._drop_channel(self)
@@ -406,8 +506,63 @@ class _Channel:
                     sent = 0
         return pieces if iov else None
 
+    def _shm_doorbell(self) -> None:
+        """Wake a peer parked in select(): one 9-byte 0x02 frame on the
+        companion socket. Called under _send_lock (concurrent bells must
+        not interleave — a torn frame would desync the socket stream the
+        heal path decodes). EAGAIN before the first byte means unread
+        bells already fill the socket buffer — the peer has wakeups
+        pending, so dropping this one is safe. EAGAIN mid-frame is
+        different: the frame MUST complete or the stream desyncs, and
+        the peer drains the socket every loop pass, so a brief retry
+        always lands."""
+        data = memoryview(_SHM_DOORBELL)
+        sent_any = False
+        while data.nbytes:
+            try:
+                n = self.sock.send(data)
+            except (BlockingIOError, InterruptedError):
+                if not sent_any:
+                    return
+                time.sleep(0.0002)
+                continue
+            except OSError:
+                return
+            if n <= 0:
+                return
+            sent_any = True
+            data = data[n:]
+
     def send(self, payload: bytes) -> None:
         wire = len(payload) + _FRAME_OVERHEAD
+        if self.shm is not None:
+            from fiber_tpu.transport.shm import (
+                _m_shm_bytes_tx, _m_shm_frames_tx)
+
+            ring = self.shm.tx
+            with self._send_lock:
+                if len(payload) > SMALL_FRAME_MAX:
+                    # Large path: header+tag first, then the payload
+                    # memoryview straight into the ring — ONE copy, the
+                    # zero-copy promise of the engine.
+                    bell = ring.write(pack_header(len(payload) + 1)
+                                      + _T_DATA)
+                    ring.write(payload)
+                else:
+                    if not isinstance(payload, (bytes, bytearray)):
+                        payload = bytes(payload)
+                    bell = ring.write(pack_header(len(payload) + 1)
+                                      + _T_DATA + payload)
+                self.bytes_tx += wire
+                self.frames_tx += 1
+                self.flushes_tx += 1
+                if bell or ring.reader_waiting:
+                    self._shm_doorbell()
+            _m_bytes_tx.inc(wire)
+            _m_frames_tx.inc()
+            _m_shm_bytes_tx.inc(wire)
+            _m_shm_frames_tx.inc()
+            return
         if self._io_selector:
             header = pack_header(len(payload) + 1)
             if len(payload) > SMALL_FRAME_MAX:
@@ -431,6 +586,24 @@ class _Channel:
 
     def send_credit(self, n: int) -> None:
         wire = _CREDIT.size + _FRAME_OVERHEAD
+        if self.shm is not None:
+            from fiber_tpu.transport.shm import (
+                _m_shm_bytes_tx, _m_shm_frames_tx)
+
+            body = _T_CREDIT + _CREDIT.pack(n)
+            ring = self.shm.tx
+            with self._send_lock:
+                bell = ring.write(pack_header(len(body)) + body)
+                self.bytes_tx += wire
+                self.frames_tx += 1
+                self.flushes_tx += 1
+                # Credits must doorbell too: a starved sender is blocked
+                # on THIS frame reaching the peer's parked read loop.
+                if bell or ring.reader_waiting:
+                    self._shm_doorbell()
+            _m_shm_bytes_tx.inc(wire)
+            _m_shm_frames_tx.inc()
+            return
         if self._io_selector:
             body = _T_CREDIT + _CREDIT.pack(n)
             self._tx_enqueue(
@@ -444,6 +617,11 @@ class _Channel:
 
     def close(self) -> None:
         self.alive = False
+        if self.shm is not None:
+            # Closing the rings wakes a writer blocked on a full ring
+            # (RingClosed is an OSError, so it rides the normal drop
+            # paths); closing the socket EOFs the peer's read loop.
+            self.shm.close()
         if self._io_selector and self._loop is not None:
             self._loop.close_channel(self)
             return
@@ -467,7 +645,7 @@ class Endpoint:
         # that compare the engines side by side. docs/transport.md.
         self._io = io or str(getattr(config.get(), "transport_io",
                                      "selector"))
-        if self._io not in ("selector", "threads"):
+        if self._io not in ("selector", "threads", "shm"):
             raise ValueError(f"invalid transport_io {self._io!r}")
         # r-mode credit window: 1 = pure demand-driven (a dead consumer
         # never has frames parked beyond what a blocked reader asked
@@ -575,6 +753,16 @@ class Endpoint:
                 sock.close()
                 raise
         self.addr = addr
+        if self._io == "shm":
+            # Negotiate rings strictly before any data frame; a binder
+            # that doesn't speak shm answers with its normal first wire
+            # frame, which comes back as `leftover` and is re-injected
+            # through the shared ingress so nothing is lost.
+            from fiber_tpu.transport import shm as shm_mod
+
+            pair, leftover = shm_mod.negotiate_dialer(sock)
+            self._add_channel(sock, shm=pair, initial_frame=leftover)
+            return self
         self._add_channel(sock)
         return self
 
@@ -600,8 +788,28 @@ class Endpoint:
                     target=self._authenticate_and_add, args=(sock,),
                     name="fiber-ep-auth", daemon=True,
                 ).start()
+            elif self._io == "shm":
+                # Negotiation blocks on the dialer's first frame —
+                # off-thread so accepts keep flowing.
+                threading.Thread(
+                    target=self._negotiate_and_add, args=(sock,),
+                    name="fiber-ep-shm-neg", daemon=True,
+                ).start()
             else:
                 self._add_channel(sock)
+
+    def _negotiate_and_add(self, sock: pysocket.socket) -> None:
+        from fiber_tpu.transport import shm as shm_mod
+
+        try:
+            pair, leftover = shm_mod.negotiate_binder(sock)
+        except OSError:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        self._add_channel(sock, shm=pair, initial_frame=leftover)
 
     def _authenticate_and_add(self, sock: pysocket.socket) -> None:
         try:
@@ -626,13 +834,22 @@ class Endpoint:
             except OSError:
                 pass
             return
+        if self._io == "shm":
+            self._negotiate_and_add(sock)
+            return
         self._add_channel(sock)
 
-    def _add_channel(self, sock: pysocket.socket) -> None:
-        chan = _Channel(sock, self)
+    def _add_channel(self, sock: pysocket.socket, shm=None,
+                     initial_frame=None) -> None:
+        chan = _Channel(sock, self, shm=shm)
         with self._chan_lock:
             self._channels.append(chan)
             self._chan_lock.notify_all()
+        if initial_frame is not None:
+            # A wire frame consumed during shm negotiation (the peer
+            # spoke plain TCP first): run it through the shared ingress
+            # before the I/O engine starts, preserving frame order.
+            chan.handle_frame(initial_frame)
         # Every channel gets an I/O engine: data/credit frames for
         # receiving modes, EOF detection for send-only ones.
         chan.start_io()
@@ -972,6 +1189,12 @@ def connect_transport(mode: str, addr: str, native: bool = True,
     its own single-shot connect)."""
     host, port = parse_addr(addr)
     native_mode = _NATIVE_MODE_MAP.get(mode) if native else None
+    if str(getattr(config.get(), "transport_io", "selector")) == "shm":
+        # The C client speaks plain TCP and can't join an shm
+        # negotiation; under the shm engine the Python Endpoint IS the
+        # fast path (rings beat loopback TCP), so native would be a
+        # downgrade here.
+        native_mode = None
     if native_mode is not None and host.count(".") == 3 and \
             host.replace(".", "").isdigit():
         try:
@@ -1010,13 +1233,18 @@ class Device:
                     f"{ip!r} with the default cluster key; set "
                     "FIBER_CLUSTER_KEY (fiber-tpu up generates one)"
                 )
-            try:
-                from fiber_tpu._native import NativePump, available
+            # Under the shm engine the Python endpoints negotiate rings
+            # per channel — the TCP-only native pump would silently put
+            # every same-host frame back on loopback sockets.
+            if str(getattr(config.get(), "transport_io",
+                           "selector")) != "shm":
+                try:
+                    from fiber_tpu._native import NativePump, available
 
-                if available():
-                    self._native = NativePump(duplex, bind_ip=ip)
-            except Exception:
-                self._native = None
+                    if available():
+                        self._native = NativePump(duplex, bind_ip=ip)
+                except Exception:
+                    self._native = None
         if self._native is not None:
             self.in_ep = None
             self.out_ep = None

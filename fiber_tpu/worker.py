@@ -77,6 +77,23 @@ def _apply_preparation(prep: dict) -> None:
 
     config.init_from(prep["fiber_config"])
 
+    if str(getattr(config.get(), "transport_io", "selector")) == "shm":
+        # Same-host rings only engage when both peers share a placement
+        # key; a remote worker under the shm engine pays the negotiate
+        # timeout per master-bound connection and then runs TCP. Say so
+        # once at bootstrap — the operator reading zeroed transport_shm_*
+        # counters should not have to rediscover this.
+        from fiber_tpu.sched import local_host_key
+
+        master_key = prep.get("master_host_key")
+        if master_key is not None and master_key != local_host_key():
+            import logging as _logging
+
+            _logging.getLogger("fiber_tpu").info(
+                "transport_io=shm but this worker (host key %s) is not "
+                "on the master's host (%s); master-bound connections "
+                "negotiate down to TCP", local_host_key(), master_key)
+
     # Telemetry enablement / sampling / span-buffer capacity follow the
     # master's config, adopted above — so one knob governs the whole
     # process tree, and spans this worker records (pool.py task loop)

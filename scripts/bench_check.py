@@ -39,6 +39,8 @@ DIRECTIONS: Dict[str, str] = {
     "sched_gates": "special",          # ratio fields, see below
     # transport plane
     "transport_selector_vs_threads": "special",
+    # master scale-out (hier + shm vs single-master baseline)
+    "scale_hier_vs_direct": "special",
     # durable-map recovery
     "recovery_gates": "special",
     # full-stack cluster bench
@@ -53,6 +55,8 @@ RATIO_FIELDS: Dict[str, List[Tuple[str, str]]] = {
                     ("uniform_overhead", "lower")],
     "transport_selector_vs_threads": [("value", "higher"),
                                       ("large_ratio", "higher")],
+    "scale_hier_vs_direct": [("value", "higher"),
+                             ("master_cpu_per_task_ratio", "lower")],
     "recovery_gates": [("ledger_overhead", "lower"),
                        ("resume_ratio", "lower")],
 }

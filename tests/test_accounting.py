@@ -247,6 +247,37 @@ def test_kill_worker_resubmit_bills_each_task_exactly_once(tmp_path):
     assert plan.spent("kill") == 1  # the fault actually fired
 
 
+@pytest.mark.parametrize("io", ["threads", "selector", "shm"])
+def test_wire_reconciliation_across_io_engines(io):
+    """Billed wire equals the pool endpoints' framing-boundary counters
+    under every transport engine — the regression bar for swapping the
+    I/O core beneath the accounting plane. Under shm this also proves
+    the doorbell wake frames stay off both ledgers (they are dropped
+    before the counting ingress by design)."""
+    fiber_tpu.init(worker_lite=True, transport_io=io)
+    job = f"acct-io-{io}"
+    with fiber_tpu.Pool(2) as pool:
+        xs = list(range(40))
+        assert pool.map(targets.square, xs, chunksize=2,
+                        job_id=job) == [x * x for x in xs]
+        _wait(lambda: _single_report(pool, job)["reports"]
+              [0]["total"].get("tasks") == 40.0,
+              what="all 40 tasks billed")
+        c = _single_report(pool, job)
+        totals = c["totals"]
+        xp = c["transport"]
+        billed_tx = totals.get("wire_tx", 0.0)
+        billed_rx = totals.get("wire_rx", 0.0)
+        wire_tx = xp["task_ep"]["bytes_tx"]
+        wire_rx = (xp["task_ep"]["bytes_rx"]
+                   + xp["result_ep"]["bytes_rx"])
+        assert billed_tx == wire_tx, (io, billed_tx, wire_tx)
+        # in-flight trailing frames (heartbeats, late cost frames):
+        # bounded positive slack, never a deficit
+        assert 0 <= wire_rx - billed_rx <= 8192, \
+            (io, billed_rx, wire_rx)
+
+
 def test_speculation_first_result_wins_bills_once(tmp_path):
     """A speculative duplicate executes the chunk twice; the loser's
     fill dedups — billed tasks stays exactly the map size while the

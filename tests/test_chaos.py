@@ -138,18 +138,18 @@ def test_ingress_stall_longer_than_suspect_timeout_resubmits(chaos_plan):
         assert pool._detector.suspected_total >= 1
 
 
-@pytest.mark.parametrize("io", ["threads", "selector"])
+@pytest.mark.parametrize("io", ["threads", "selector", "shm"])
 def test_transport_drop_frames_endpoint_level(chaos_plan, io):
     """Bound-r ingress frame DROP at the Endpoint boundary: lost frames
     stay lost (loss model), the rest keep flowing, and the sender's
     credit window is compensated so throughput doesn't decay.
 
-    Parametrized over both I/O engines (docs/transport.md): the chaos
+    Parametrized over every I/O engine (docs/transport.md): the chaos
     plan consults one counter per channel (`recv_frame_actions`), so the
     drop schedule AND the credit compensation must be observably
-    identical under the selector event loop and the thread-per-
-    connection fallback — asserted below down to the exact credit-frame
-    count."""
+    identical under the selector event loop, the thread-per-connection
+    fallback and the shm ring engine — asserted below down to the
+    exact credit-frame count."""
     from fiber_tpu import serialization
     from fiber_tpu.transport.tcp import Endpoint
 
@@ -288,13 +288,13 @@ def test_chaos_soak_repeated_kills(chaos_plan):
             [x * x for x in xs]
 
 
-@pytest.mark.parametrize("io", ["threads", "selector"])
+@pytest.mark.parametrize("io", ["threads", "selector", "shm"])
 def test_partition_severs_then_heals_endpoint_level(chaos_plan, io):
-    """Network partition at the Endpoint boundary, both I/O engines:
+    """Network partition at the Endpoint boundary, every I/O engine:
     from the N-th frame the host pair is CUT — every frame (data,
     results, heartbeats) is severed for partition_s — then flow
     resumes. The schedule comes from the same `recv_frame_actions`
-    both engines consult, so it cannot diverge between them."""
+    every engine consults, so it cannot diverge between them."""
     from fiber_tpu import serialization
     from fiber_tpu.transport.tcp import Endpoint
 

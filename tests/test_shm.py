@@ -1,0 +1,299 @@
+"""Same-host shared-memory transport engine (docs/transport.md).
+
+Ring mechanics (wraparound, backpressure, the doorbell flag) plus
+endpoint-level negotiation: same-host peers land on rings, every
+mixed-engine and mismatched-host combination falls back to plain TCP
+without losing a frame.
+"""
+
+import socket as pysocket
+import threading
+import time
+
+import pytest
+
+from fiber_tpu import framing
+from fiber_tpu.transport import shm as shm_mod
+from fiber_tpu.transport.shm import MAGIC, RingClosed, ShmRing
+from fiber_tpu.transport.tcp import Endpoint
+
+IP = "127.0.0.1"
+
+
+# ---------------------------------------------------------------------------
+# ring mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_ring_wraparound_byte_identity():
+    """Hundreds of odd-sized frames through a 256-byte ring: the
+    free-running positions wrap the data area dozens of times and every
+    byte still comes back identical (the split-copy paths at the wrap
+    seam are where an off-by-one would corrupt silently)."""
+    ring = ShmRing.create(256)
+    try:
+        for i in range(300):
+            blob = bytes((i + j) % 256 for j in range(1 + (i * 37) % 97))
+            ring.write(blob)
+            got = b""
+            while len(got) < len(blob):
+                got += ring.recv(64)  # forces multi-read reassembly
+            assert got == blob, f"frame {i} corrupted"
+        assert ring.buffered() == 0
+        assert ring.write_pos > 10 * ring.capacity  # really wrapped
+    finally:
+        ring.close()
+        ring.unlink()
+
+
+def test_ring_streams_frames_larger_than_capacity():
+    """A frame bigger than the whole ring streams through in
+    capacity-bounded pieces against a concurrent reader — a huge
+    broadcast payload must never deadlock on its own backpressure."""
+    ring = ShmRing.create(256)
+    blob = bytes(range(256)) * 8  # 2 KiB through a 256-byte ring
+    got = bytearray()
+
+    def read_all():
+        while len(got) < len(blob):
+            try:
+                got.extend(ring.recv(97))
+            except BlockingIOError:
+                time.sleep(0.001)
+
+    t = threading.Thread(target=read_all)
+    t.start()
+    try:
+        ring.write(blob)
+        t.join(10)
+        assert not t.is_alive()
+        assert bytes(got) == blob
+    finally:
+        ring.close()
+        ring.unlink()
+
+
+def test_ring_backpressure_blocks_then_closes():
+    """A writer against a full ring blocks (and trips the backpressure
+    counter) until the reader frees space; closing the ring under a
+    blocked writer raises RingClosed instead of hanging forever."""
+    waits0 = shm_mod._m_shm_backpressure.value()
+    ring = ShmRing.create(256)
+    state = {}
+
+    def blocked_writer():
+        try:
+            ring.write(b"g" * 64)
+            state["wrote"] = True
+        except RingClosed:
+            state["closed"] = True
+
+    try:
+        ring.write(b"f" * 256)  # exactly full
+        t = threading.Thread(target=blocked_writer, daemon=True)
+        t.start()
+        time.sleep(0.15)
+        assert t.is_alive(), "writer must block on a full ring"
+        assert shm_mod._m_shm_backpressure.value() > waits0
+        assert ring.recv(128) == b"f" * 128  # free half the ring
+        t.join(10)
+        assert state.get("wrote")
+
+        # refill and close under a blocked writer
+        ring.write(b"h" * (256 - ring.buffered()))
+        state.clear()
+        t2 = threading.Thread(target=blocked_writer, daemon=True)
+        t2.start()
+        time.sleep(0.1)
+        ring.close()
+        t2.join(10)
+        assert state.get("closed"), "close must unblock the writer"
+    finally:
+        ring.close()
+        ring.unlink()
+
+
+def test_ring_write_reports_empty_transition_and_waiting_flag():
+    """The doorbell contract: write() returns True exactly when the
+    ring was empty at entry (the reader may have parked), and the
+    reader-owned waiting flag round-trips through the header."""
+    ring = ShmRing.create(256)
+    try:
+        assert ring.write(b"first") is True
+        assert ring.write(b"second") is False  # backlog: reader awake
+        while True:
+            try:
+                ring.recv(64)
+            except BlockingIOError:
+                break
+        assert ring.write(b"third") is True  # drained: empty again
+        assert ring.write(b"") is False  # no bytes, no bell
+
+        assert ring.reader_waiting is False
+        ring.set_waiting()
+        assert ring.reader_waiting is True
+        ring.clear_waiting()
+        assert ring.reader_waiting is False
+    finally:
+        ring.close()
+        ring.unlink()
+
+
+def test_ring_attach_rejects_stale_path():
+    """attach() verifies the header token — a recycled path that now
+    belongs to some other process's ring fails loudly instead of
+    splicing two channels together."""
+    ring = ShmRing.create(1024)
+    try:
+        other = ShmRing.attach(ring.path, ring.token, 1024)
+        other.close()
+        with pytest.raises(OSError):
+            ShmRing.attach(ring.path, b"\x00" * 16, 1024)
+        with pytest.raises((OSError, ValueError)):
+            ShmRing.attach(ring.path, ring.token, 2048)
+    finally:
+        ring.close()
+        ring.unlink()
+
+
+# ---------------------------------------------------------------------------
+# negotiation and fallback
+# ---------------------------------------------------------------------------
+
+
+def test_shm_endpoints_negotiate_rings_and_roundtrip():
+    """Two same-host shm endpoints negotiate onto rings (both channels
+    carry a ShmPair) and move small + multi-megabyte frames through
+    them — the negotiation-win counter proves the path taken."""
+    wins0 = shm_mod._m_shm_channels.value()
+    pull = Endpoint("r", io="shm")
+    addr = pull.bind(IP)
+    push = Endpoint("w", io="shm").connect(addr)
+    try:
+        assert push._channels[0].shm is not None
+        deadline = time.time() + 5
+        while not pull._channels and time.time() < deadline:
+            time.sleep(0.01)
+        assert pull._channels and pull._channels[0].shm is not None
+        assert shm_mod._m_shm_channels.value() >= wins0 + 2
+        push.send(b"small", timeout=5)
+        assert pull.recv(5) == b"small"
+        blob = b"z" * (2 * 1024 * 1024)
+        push.send(blob, timeout=5)
+        assert bytes(pull.recv(30)) == blob
+    finally:
+        push.close()
+        pull.close()
+
+
+@pytest.mark.parametrize("binder_io,dialer_io",
+                         [("shm", "threads"), ("threads", "shm")])
+def test_mixed_engines_fall_back_to_tcp(monkeypatch, binder_io,
+                                        dialer_io):
+    """One side speaks shm, the other doesn't: the handshake resolves
+    to plain TCP (shm dialer's hello is dropped as 0x02 control by the
+    plain binder; plain dialer's silence times the shm binder out) and
+    every data frame still arrives."""
+    monkeypatch.setenv("FIBER_SHM_NEGOTIATE_S", "0.2")
+    fb0 = shm_mod._m_shm_fallbacks.value()
+    pull = Endpoint("r", io=binder_io)
+    addr = pull.bind(IP)
+    push = Endpoint("w", io=dialer_io).connect(addr)
+    try:
+        assert push._channels[0].shm is None
+        for i in range(5):
+            push.send(f"m{i}".encode(), timeout=10)
+        assert [bytes(pull.recv(10)) for _ in range(5)] == \
+            [f"m{i}".encode() for i in range(5)]
+        deadline = time.time() + 5
+        while not pull._channels and time.time() < deadline:
+            time.sleep(0.01)
+        assert pull._channels[0].shm is None
+        assert shm_mod._m_shm_fallbacks.value() > fb0
+    finally:
+        push.close()
+        pull.close()
+
+
+def test_binder_naks_mismatched_host_key():
+    """A hello naming a different host key (same pod, different host:
+    the rings' /dev/shm files aren't shared) gets a NAK and the binder
+    stays on TCP — asserted at the negotiate_binder seam where the
+    dialer side can be scripted deterministically."""
+    a, b = pysocket.socketpair()
+    out = {}
+
+    def binder():
+        out["pair"], out["leftover"] = shm_mod.negotiate_binder(b)
+
+    t = threading.Thread(target=binder)
+    t.start()
+    try:
+        import json
+
+        framing.send_frame(a, MAGIC + json.dumps({
+            "host": "someone-elses-host",
+            "tx": "/dev/shm/nope", "tx_token": "00" * 16,
+            "rx": "/dev/shm/nope2", "rx_token": "00" * 16,
+            "capacity": 65536,
+        }).encode())
+        reply = bytes(framing.recv_frame_timeout(a, 5))
+        assert reply.startswith(MAGIC)
+        assert json.loads(reply[len(MAGIC):]) == {"ok": False}
+        t.join(10)
+        assert out["pair"] is None and out["leftover"] is None
+    finally:
+        a.close()
+        b.close()
+
+
+def test_dialer_returns_plain_first_frame_as_leftover():
+    """A binder that answers the hello with a DATA frame (it speaks
+    plain TCP and granted credit immediately) forces fallback, and that
+    frame comes back as ``leftover`` for re-injection — the
+    no-frame-ever-lost half of the negotiation contract."""
+    a, b = pysocket.socketpair()
+    out = {}
+
+    def dialer():
+        out["pair"], out["leftover"] = shm_mod.negotiate_dialer(a)
+
+    t = threading.Thread(target=dialer)
+    t.start()
+    try:
+        hello = bytes(framing.recv_frame_timeout(b, 5))
+        assert hello.startswith(MAGIC)
+        framing.send_frame(b, b"\x00plain-tcp-data")
+        t.join(10)
+        assert out["pair"] is None
+        assert bytes(out["leftover"]) == b"\x00plain-tcp-data"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_parked_reader_wakes_on_doorbell_quickly():
+    """End-to-end doorbell latency: let the shm read loop go fully idle
+    (parked in select() with the waiting flag up), then send one frame —
+    it must arrive in well under the 50 ms park timeout, proving the
+    wake came from the doorbell and not the timeout."""
+    pull = Endpoint("r", io="shm")
+    addr = pull.bind(IP)
+    push = Endpoint("w", io="shm").connect(addr)
+    try:
+        push.send(b"warm", timeout=5)
+        assert pull.recv(5) == b"warm"
+        for _ in range(50):  # several park cycles
+            time.sleep(0.01)
+            if push._channels[0].shm.tx.reader_waiting:
+                break
+        assert push._channels[0].shm.tx.reader_waiting, \
+            "idle shm reader never parked"
+        t0 = time.perf_counter()
+        push.send(b"wake", timeout=5)
+        assert pull.recv(5) == b"wake"
+        assert time.perf_counter() - t0 < 0.045, \
+            "frame latency suggests the park timeout, not the doorbell"
+    finally:
+        push.close()
+        pull.close()

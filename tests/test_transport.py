@@ -316,18 +316,21 @@ def test_endpoint_flood_evicts_oldest_not_newest():
 
 
 # ---------------------------------------------------------------------------
-# I/O engines (docs/transport.md): the selector event loop vs the
-# thread-per-connection fallback. `io=` pins an engine per endpoint so the
-# two can be compared in one process regardless of the transport_io default.
+# I/O engines (docs/transport.md): the selector event loop, the
+# thread-per-connection fallback, and the same-host shm ring engine.
+# `io=` pins an engine per endpoint so they can be compared in one
+# process regardless of the transport_io default.
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("io", ["threads", "selector"])
+@pytest.mark.parametrize("io", ["threads", "selector", "shm"])
 def test_io_mode_roundtrip_and_exact_counters(io):
-    """Both engines move the same traffic with byte-identical wire
+    """Every engine moves the same traffic with byte-identical wire
     counters at the framing boundary: 8-byte header + 1-byte type tag
     per frame, large payloads included (the acceptance bar for swapping
-    the I/O core under the store plane's wire-counter assertions)."""
+    the I/O core under the store plane's wire-counter assertions — and
+    for the shm engine, proof the doorbell frames stay off the
+    counters)."""
     pull = Endpoint("r", io=io)
     addr = pull.bind(IP)
     push = Endpoint("w", io=io).connect(addr)
@@ -418,13 +421,13 @@ def test_small_frame_coalescing_flush_count():
         pull.close()
 
 
-@pytest.mark.parametrize("io", ["threads", "selector"])
+@pytest.mark.parametrize("io", ["threads", "selector", "shm"])
 def test_credit_replenish_is_batched(io):
     """Bound-r ingress replenishes its standing credit window in batches
     of 32 — a burst of N small data frames costs the receiver exactly
     ceil(N/32) replenish credit frames (plus the one connection-time
     window grant), asserted through the EXACT frames_tx/frames_rx
-    counters under both I/O engines. Under the selector engine those
+    counters under every I/O engine. Under the selector engine those
     replenish frames also ride the coalescing write queue, so the
     syscall count is <= the frame count."""
     pull = Endpoint("r", io=io)

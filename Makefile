@@ -5,7 +5,8 @@ PYTEST ?= python -m pytest tests/ -q
 
 .PHONY: test stest test-all lint bench bench-store bench-telemetry \
 	bench-sched bench-transport bench-cluster bench-recovery \
-	bench-accounting bench-check bench-scale weakscale docs chaos
+	bench-accounting bench-check bench-scale bench-ici weakscale \
+	docs chaos
 
 # Tier 1: local backend (subprocess jobs)
 test:
@@ -116,6 +117,19 @@ bench-scale:
 bench-cluster:
 	JAX_PLATFORMS=cpu python bench.py --cluster --record > BENCH_cluster.json; \
 	rc=$$?; cat BENCH_cluster.json; exit $$rc
+
+# Device-tier data plane gate (docs/objectstore.md "Device tier"):
+# repeat-generation param resolutions must come out of the
+# device-resident store with ~zero wire bytes, and the collective
+# broadcast path (one mesh replication, accounted under the `ici`
+# transfer site) must beat the tier-off baseline that re-pays the
+# host->mesh transfer every call by >= 1.3x wall. Runs on the
+# forced-host-device CPU mesh; the record lands in BENCH_ici.json
+# either way.
+bench-ici:
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	JAX_PLATFORMS=cpu python bench.py --ici --record > BENCH_ici.json; \
+	rc=$$?; cat BENCH_ici.json; exit $$rc
 
 # Durable-map recovery gate (docs/robustness.md): write-ahead ledger
 # overhead on the no-crash path (must stay <= 5%) and resume wall-time

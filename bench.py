@@ -725,6 +725,46 @@ def _cluster_bench(args) -> int:
            "budget": _CLUSTER_BYTES_PER_TASK_MAX,
            "ship_by_value_bytes": int(payload_mb * (1 << 20))})
 
+    # -- phase 1b: device-path map with analytic FLOPs -----------------
+    # Same pod, but the eval is @meta(device=True, flops=…): the map
+    # lowers onto the mesh, the broadcast param rides the device store
+    # tier (docs/objectstore.md "Device tier"), and the pool feeds
+    # DEVICE.note_map_flops so live MFU is recorded per map. Under
+    # FIBER_PEAK_FLOPS (or a real TPU kind) mfu must be non-null; HBM
+    # stays an honest null wherever memory_stats() is unavailable.
+    from fiber_tpu import store as storemod
+    from fiber_tpu.meta import meta as fmeta
+    from fiber_tpu.telemetry.device import DEVICE as devplane
+
+    dev_eval = fmeta(device=True, flops=2.0 * n_elems)(_ici_eval)
+    dev_items = [(base_arr, np.float32(i)) for i in range(tasks)]
+    with fiber_tpu.Pool(workers) as pool:
+        out = pool.starmap(dev_eval, dev_items)  # compile + tier fill
+        t0 = time.perf_counter()
+        for _ in range(gens):
+            out = pool.starmap(dev_eval, dev_items)
+        dev_wall = time.perf_counter() - t0
+        assert len(out) == tasks
+    dsnap = devplane.snapshot()
+    dev_mfu = (dsnap.get("mfu") or {}).get("mfu")
+    dev_peak_row = (dsnap.get("mfu") or {}).get("peak_row")
+    hbm = dsnap.get("hbm") or {}
+    ici_site = (dsnap.get("transfers") or {}).get("ici") or {}
+    tier = storemod._dtier  # peek: never instantiate from a bench read
+    tier_stats = tier.stats() if tier is not None else {}
+    dev_mfu_broken = dev_peak_row is not None and dev_mfu is None
+    _emit({"metric": "cluster_device_mfu",
+           "value": _round_mfu(dev_mfu), "unit": "mfu",
+           "peak_row": dev_peak_row,
+           "flops_per_item": 2.0 * n_elems,
+           "generations": gens, "tasks_per_gen": tasks,
+           "payload_mb": payload_mb, "wall_s": round(dev_wall, 3),
+           "hbm_bytes_in_use": hbm.get("bytes_in_use"),
+           "hbm_bytes_limit": hbm.get("bytes_limit"),
+           "ici_transfer_bytes": int(ici_site.get("bytes", 0)),
+           "device_tier_hits": int(tier_stats.get("hits", 0)),
+           "device_tier_bytes": int(tier_stats.get("bytes", 0))})
+
     # -- phase 2: straggler chaos + explain ----------------------------
     from fiber_tpu.telemetry.flightrec import FLIGHT
 
@@ -817,6 +857,7 @@ def _cluster_bench(args) -> int:
            "explain_primary": verdict["primary"],
            "postmortem_ok": bundle_ok,
            "mfu_broken": bool(mfu_broken),
+           "device_mfu_broken": bool(dev_mfu_broken),
            "under_floor": bool(slow), "over_budget": bool(fat),
            "misattributed": bool(misattributed)})
     rc = 0
@@ -840,6 +881,11 @@ def _cluster_bench(args) -> int:
     if mfu_broken:
         print("FAIL: device peak resolved but mfu is null — "
               "utils/flops.py wiring broke", file=sys.stderr)
+        rc = 1
+    if dev_mfu_broken:
+        print("FAIL: device peak resolved but the @meta(device=True, "
+              "flops=…) map recorded a null mfu — "
+              "DEVICE.note_map_flops wiring broke", file=sys.stderr)
         rc = 1
     return rc
 
@@ -1229,6 +1275,164 @@ def _scale_bench(args) -> int:
     return 1 if (slow or hot) else 0
 
 
+#: `make bench-ici` gates (docs/objectstore.md "Device tier"): repeat
+#: resolutions of an already-device-resident param may cost at most
+#: this many wire bytes (control frames only — the payload must come
+#: out of the device tier), and the device-tier broadcast path must
+#: beat the tier-off baseline (param stacked per item into the batched
+#: transfer) by this wall factor.
+_ICI_REPEAT_WIRE_MAX = 4096
+_ICI_WALL_RATIO_FLOOR = 1.3
+
+
+def _ici_eval(params, x):
+    """Per-item device eval against a broadcast param vector: one full
+    reduction over params mixed with the item scalar. ``params`` rides
+    vmap's in_axes=None; with the device tier ON it is mesh-resident
+    across generations, OFF it re-pays the host->mesh transfer every
+    call."""
+    import jax.numpy as jnp
+
+    return jnp.sum(params * params) * jnp.float32(1e-6) + x
+
+
+def _ici_bench(args) -> int:
+    """Device-tier data plane bench (`make bench-ici`,
+    docs/objectstore.md "Device tier"). CPU-runnable: the mesh is the
+    xla_force_host_platform device set; the Pallas remote-DMA kernels
+    are numerics-gated by tests, not timed here. Two arms:
+
+    1. **repeat-resolution wire bytes**: an ``--ici-mb`` param resolved
+       ``--ici-gens`` times through the store plane with
+       ``device=True``, host caches dropped between generations. Gen 1
+       pays one wire fetch plus one mesh replication (billed under the
+       ``ici`` transfer site); every repeat generation must come out of
+       the device tier with ~zero further wire bytes. The PR-2
+       host-cache baseline re-fetches the payload here — its host copy
+       is gone, and it has no device-resident tier to fall back on.
+    2. **broadcast wall ratio**: ``--ici-gens`` generations of a
+       device-path Pool.starmap over a shared ``--ici-mb`` param with
+       the device tier ON (collective broadcast: one replication, then
+       digest-dedup'd reuse across generations) vs OFF (every map
+       re-pays the host->mesh transfer) — gated >= 1.3x, best-of-3
+       interleaved."""
+    import numpy as np
+
+    import fiber_tpu
+    from fiber_tpu import serialization
+    from fiber_tpu import store as storemod
+    from fiber_tpu.meta import meta
+    from fiber_tpu.store import LocalStore
+    from fiber_tpu.store.plane import StoreClient, StoreServer
+    from fiber_tpu.telemetry.device import DEVICE
+
+    payload_mb = float(args.ici_mb)
+    gens = max(2, int(args.ici_gens))
+    tasks = int(args.ici_tasks)
+
+    fiber_tpu.init(store_enabled=True)
+    storemod.reset()
+    tier = storemod.device_store_tier()
+    if tier is None:
+        print("FAIL: device store tier is disabled "
+              "(store_device_enabled=False?)", file=sys.stderr)
+        return 1
+    arr = np.random.default_rng(7).standard_normal(
+        int(payload_mb * (1 << 20) / 4)).astype(np.float32)
+
+    def ici_site_bytes() -> int:
+        site = DEVICE.snapshot()["transfers"].get("ici") or {}
+        return int(site.get("bytes", 0))
+
+    # -- arm 1: repeat-generation resolution --------------------------
+    blob = serialization.dumps(arr)
+    st = LocalStore(capacity_bytes=512 << 20)
+    server = StoreServer(st, "127.0.0.1")
+    ref = st.put_bytes(blob)
+    wire_ref = type(ref)(ref.digest, ref.size, server.addr, True)
+    ici_before = ici_site_bytes()
+    client = StoreClient(LocalStore(capacity_bytes=512 << 20))
+    first = client.resolve(wire_ref, device=True)
+    client.close()
+    served_first = server.stats()["bytes_served"]
+    for _ in range(gens - 1):
+        # A FRESH client per generation: no host RAM/disk copy
+        # survives, so a free repeat resolution can only mean a device
+        # tier hit.
+        c = StoreClient(LocalStore(capacity_bytes=512 << 20))
+        again = c.resolve(wire_ref, device=True)
+        c.close()
+        assert again is not None
+    served_total = server.stats()["bytes_served"]
+    server.close()
+    repeat_wire = served_total - served_first
+    tstats = tier.stats()
+    ici_bytes = ici_site_bytes() - ici_before
+    # Sanity on the resolved payload, not just the byte counters.
+    assert first is not None
+    leaves_ok = int(np.asarray(first).shape[0]) == arr.shape[0]
+    _emit({"metric": "ici_repeat_wire_bytes", "value": int(repeat_wire),
+           "unit": "bytes", "budget": _ICI_REPEAT_WIRE_MAX,
+           "generations": gens, "payload_mb": payload_mb,
+           "first_gen_wire_bytes": int(served_first),
+           "device_tier_hits": int(tstats.get("hits", 0)),
+           "ici_transfer_bytes": int(ici_bytes),
+           "payload_shape_ok": bool(leaves_ok)})
+
+    # -- arm 2: broadcast wall ratio, tier on vs off -------------------
+    ev = meta(device=True)(_ici_eval)
+    items = [(arr, np.float32(i)) for i in range(tasks)]
+    walls = {"on": None, "off": None}
+    for _ in range(3):
+        for mode in ("on", "off"):
+            fiber_tpu.init(store_device_enabled=(mode == "on"))
+            with fiber_tpu.Pool(2) as pool:
+                out = pool.starmap(ev, items)  # compile + gen-1 put
+                assert len(out) == tasks
+                t0 = time.perf_counter()
+                for _ in range(gens):
+                    out = pool.starmap(ev, items)
+                wall = time.perf_counter() - t0
+            assert len(out) == tasks
+            walls[mode] = wall if walls[mode] is None \
+                else min(walls[mode], wall)
+    fiber_tpu.init()
+    ratio = walls["off"] / max(walls["on"], 1e-9)
+    slow = ratio < _ICI_WALL_RATIO_FLOOR
+    fat = repeat_wire > _ICI_REPEAT_WIRE_MAX
+    starved = tstats.get("hits", 0) < gens - 1
+    _emit({"metric": "ici_broadcast_wall_ratio", "value": round(ratio, 3),
+           "unit": "x vs tier-off", "floor": _ICI_WALL_RATIO_FLOOR,
+           "generations": gens, "tasks": tasks,
+           "payload_mb": payload_mb,
+           "wall_on_s": round(walls["on"], 4),
+           "wall_off_s": round(walls["off"], 4)})
+    _emit({"metric": "ici_gates",
+           "repeat_wire_bytes": int(repeat_wire),
+           "wire_budget": _ICI_REPEAT_WIRE_MAX,
+           "wall_ratio": round(ratio, 3),
+           "ratio_floor": _ICI_WALL_RATIO_FLOOR,
+           "device_tier_hits": int(tstats.get("hits", 0)),
+           "over_budget": bool(fat), "under_floor": bool(slow),
+           "tier_cold": bool(starved)})
+    rc = 0
+    if fat:
+        print(f"FAIL: repeat-generation wire bytes {repeat_wire} exceed "
+              f"budget {_ICI_REPEAT_WIRE_MAX} — repeats are not coming "
+              "out of the device tier", file=sys.stderr)
+        rc = 1
+    if starved:
+        print(f"FAIL: device tier hits {tstats.get('hits', 0)} < "
+              f"{gens - 1} — repeat resolutions missed the tier",
+              file=sys.stderr)
+        rc = 1
+    if slow:
+        print(f"FAIL: device-tier broadcast wall ratio {ratio:.2f}x "
+              f"below floor {_ICI_WALL_RATIO_FLOOR}x", file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--platform", default="",
@@ -1383,6 +1587,25 @@ def main() -> int:
                              "hierarchical arm")
     parser.add_argument("--scale-workers", type=int, default=4,
                         help="sub-worker count for both --scale arms")
+    parser.add_argument("--ici", action="store_true",
+                        help="device-tier data plane bench "
+                             "(docs/objectstore.md 'Device tier'): "
+                             "repeat-generation param resolutions must "
+                             "come out of the device-resident store "
+                             "with ~zero wire bytes, and the collective "
+                             "broadcast path must beat the tier-off "
+                             "re-transfer-every-call baseline by >= "
+                             "1.3x wall. Runs on JAX_PLATFORMS=cpu (the "
+                             "forced-host-device mesh stands in for "
+                             "the pod)")
+    parser.add_argument("--ici-mb", type=float, default=8.0,
+                        help="broadcast param size for --ici")
+    parser.add_argument("--ici-gens", type=int, default=4,
+                        help="generations (repeat resolutions / timed "
+                             "maps) for --ici")
+    parser.add_argument("--ici-tasks", type=int, default=16,
+                        help="tasks per generation for the --ici wall "
+                             "arm")
     parser.add_argument("--profile", default="",
                         help="write a jax.profiler trace of the timed ES "
                              "section to this directory (inspect with "
@@ -1395,11 +1618,11 @@ def main() -> int:
     if sum((args.poet, args.pixels, args.biped, args.attention,
             args.lm, args.store, args.telemetry, args.sched,
             args.transport, args.cluster, args.recovery,
-            args.accounting, args.scale)) > 1:
+            args.accounting, args.scale, args.ici)) > 1:
         parser.error("--poet/--pixels/--biped/--attention/--lm/--store/"
                      "--telemetry/--sched/--transport/--cluster/"
-                     "--recovery/--accounting/--scale are mutually "
-                     "exclusive")
+                     "--recovery/--accounting/--scale/--ici are "
+                     "mutually exclusive")
     if args.record:
         _arm_record()
     if args.store:
@@ -1422,6 +1645,8 @@ def main() -> int:
         return _recovery_bench(args)  # host-plane only, like --store
     if args.scale:
         return _scale_bench(args)  # host-plane only, like --store
+    if args.ici:
+        return _ici_bench(args)  # CPU mesh stands in for the pod
     if args.pop is not None and args.pop < 2:
         parser.error("--pop must be >= 2")
     if args.steps is not None and args.steps < 1:

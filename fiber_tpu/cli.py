@@ -759,6 +759,17 @@ def _render_mfu(device: dict) -> str:
     return f"{float(mfu):.1%}" if mfu is not None else "-"
 
 
+def _render_dstore(device: dict) -> str:
+    """Device store tier occupancy: resident bytes, '!' suffix while the
+    hbm_fill watchdog holds the tier demoted, '-' when the host never
+    built a tier (disabled or no device work yet)."""
+    n = (device or {}).get("dev_store_bytes")
+    if n is None:
+        return "-"
+    mark = "!" if (device or {}).get("dev_store_demoted") else ""
+    return f"{_human_bytes(n)}{mark}"
+
+
 def _render_top_rows(pulls) -> list:
     """Monitor snapshots -> aligned table rows (one per host). Shared
     by cmd_top and its tests; anomaly flags come from each host's
@@ -786,6 +797,7 @@ def _render_top_rows(pulls) -> list:
             f"{_human_bytes(last.get('bytes_rx_per_s', 0.0)):>10}/s "
             f"{max(ages.values(), default=0.0):>7.2f}s "
             f"{_render_hbm(device):>15} "
+            f"{_render_dstore(device):>8} "
             f"{_render_mfu(device):>6} "
             f"{flags}")
     return rows
@@ -802,7 +814,7 @@ def _human_bytes(n: float) -> str:
 
 _TOP_HEADER = (f"{'HOST':<22} {'EVALS/S':>8} {'INFLIGHT':>9} "
                f"{'QUEUE':>7} {'TX':>12} {'RX':>12} {'HB-AGE':>8} "
-               f"{'HBM':>15} {'MFU':>6} ANOMALIES")
+               f"{'HBM':>15} {'DSTORE':>8} {'MFU':>6} ANOMALIES")
 
 
 def cmd_top(args) -> int:

@@ -132,6 +132,17 @@ DEFAULTS: Dict[str, Any] = {
     # where the staging root is FIBER_AGENT_STAGING or
     # ~/.fiber_tpu/staging (utils/staging.py / host_agent.py).
     "store_dir": "",
+    # Device-resident store tier (docs/objectstore.md "Device tier"):
+    # device-destined payloads are cached ON the accelerator (digest ->
+    # replicated jax.Array + sharding metadata) so repeat resolutions
+    # of the same content ride ICI instead of re-paying wire + H2D.
+    # Demoted to the host tiers by the `hbm_fill` watchdog rule
+    # (closed-loop remediation; re-promoted when the rule clears).
+    "store_device_enabled": True,
+    # HBM budget of the device tier, MB. Colder entries are dropped LRU
+    # past it (safe: the host RAM/disk tiers still hold the bytes);
+    # pinned entries are untouchable.
+    "store_device_capacity_mb": 256,
     # --- durability (docs/robustness.md "Durable maps") ---
     # Write-ahead map ledger: Pool.map(..., job_id=...) journals the
     # task spec + every completed chunk's result digest under

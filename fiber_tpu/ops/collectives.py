@@ -65,6 +65,25 @@ def all_gather_sharded(x, mesh=None, axis: str = "pool"):
     return jax.jit(lambda a: a, out_shardings=replicated)(x)
 
 
+def broadcast_to_mesh(x, mesh=None):
+    """Replicate a host array onto every device of the mesh paying ONE
+    host->device crossing: the array lands on the first mesh device,
+    then the replicated ``device_put`` fans it out device-to-device
+    over ICI (a naive replicated put of a host array is n_dev separate
+    host transfers). The data-plane primitive behind the store's device
+    tier (docs/objectstore.md "Device tier") — callers account the
+    movement themselves (the tier bills it under the ``ici`` site)."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from fiber_tpu.parallel.mesh import default_mesh
+
+    mesh = mesh or default_mesh()
+    first = jax.device_put(np.asarray(x), next(iter(mesh.devices.flat)))
+    return jax.device_put(first, NamedSharding(mesh, P()))
+
+
 # ---------------------------------------------------------------------------
 # Host-plane ring collectives (DCN / TCP)
 # ---------------------------------------------------------------------------

@@ -184,8 +184,32 @@ def _merge_partials(o1, lse1, o2, lse2):
     return o1 * w1t + o2 * w2t, m + jnp.log(denom)
 
 
+def _kv_rotate(k_cur, v_cur, *, axis: str, n_dev: int,
+               use_dma_ring: bool, interpret: bool):
+    """One ring rotation of the KV pair. ``use_dma_ring=True`` swaps
+    the synchronous ``ppermute`` pair for the Pallas async remote-DMA
+    exchange (ops/dma_ring): both blocks' DMAs are in flight at once
+    and the copy engine runs beside compute instead of serializing the
+    program on each transfer. Forward-only (no VJP) — callers needing
+    gradients keep the default. ``interpret=True`` forces the Pallas
+    interpreter; False auto-detects (interpreter off-TPU)."""
+    import jax
+
+    if use_dma_ring:
+        from fiber_tpu.ops.dma_ring import ring_exchange
+
+        k_cur, v_cur = ring_exchange(
+            (k_cur, v_cur), axis=axis, n_dev=n_dev,
+            interpret=True if interpret else None)
+        return k_cur, v_cur
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+    return (jax.lax.ppermute(k_cur, axis, perm),
+            jax.lax.ppermute(v_cur, axis, perm))
+
+
 def _ring_flash_local(q_blk, k_blk, v_blk, *, axis: str, n_dev: int,
-                      causal: bool, interpret: bool):
+                      causal: bool, interpret: bool,
+                      use_dma_ring: bool = False):
     """Ring attention with the Pallas flash kernel as the per-device
     block: each rotation runs flash over (local Q, visiting KV) and the
     (out, lse) partials merge exactly (:func:`_merge_partials`).
@@ -206,7 +230,6 @@ def _ring_flash_local(q_blk, k_blk, v_blk, *, axis: str, n_dev: int,
 
     sq, h, _ = q_blk.shape
     my = jax.lax.axis_index(axis)
-    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
 
     def full_block(k_cur, v_cur):
         o, lse = flash_attention_lse(q_blk, k_cur, v_cur, causal=False,
@@ -237,8 +260,9 @@ def _ring_flash_local(q_blk, k_blk, v_blk, *, axis: str, n_dev: int,
 
     def body(carry, _):
         k_cur, v_cur, src, o, lse = carry
-        k_cur = jax.lax.ppermute(k_cur, axis, perm)
-        v_cur = jax.lax.ppermute(v_cur, axis, perm)
+        k_cur, v_cur = _kv_rotate(k_cur, v_cur, axis=axis, n_dev=n_dev,
+                                  use_dma_ring=use_dma_ring,
+                                  interpret=interpret)
         src = (src - 1) % n_dev
         o2, lse2 = one_rotation(k_cur, v_cur, src)
         o, lse = _merge_partials(o, lse, o2, lse2)
@@ -254,7 +278,8 @@ def ring_attention_local(q_blk, k_blk, v_blk, *, axis: str,
                          n_devices: int | None = None,
                          causal: bool = False,
                          local: str = "xla",
-                         interpret: bool = False):
+                         interpret: bool = False,
+                         use_dma_ring: bool = False):
     """The raw per-device ring-attention body, for COMPOSITION inside a
     caller's own ``shard_map``.
 
@@ -277,6 +302,12 @@ def ring_attention_local(q_blk, k_blk, v_blk, *, axis: str,
     axis's true size (the ``axis_size`` shim in utils/jaxcompat —
     ``jax.lax.axis_size`` only exists on newer jax) — pass it only to
     override, and beware a mismatch silently drops KV blocks.
+
+    ``use_dma_ring=True`` rotates KV via the Pallas async remote-DMA
+    exchange (ops/dma_ring) instead of ``ppermute`` — both blocks'
+    transfers overlap each other and the per-rotation compute.
+    Forward-only (the DMA primitive has no VJP); numerics are pinned
+    against the ppermute path in tests.
     """
     import jax
     import jax.numpy as jnp
@@ -288,13 +319,13 @@ def ring_attention_local(q_blk, k_blk, v_blk, *, axis: str,
     if local == "flash":
         return _ring_flash_local(q_blk, k_blk, v_blk, axis=axis,
                                  n_dev=n_dev, causal=causal,
-                                 interpret=interpret)
+                                 interpret=interpret,
+                                 use_dma_ring=use_dma_ring)
     # "blockwise" is ulysses_attention's name for the same chunked
     # online-softmax engine — accepted here so the two sequence-parallel
     # planes share an engine vocabulary.
     if local not in ("xla", "blockwise"):
         raise ValueError(f"unknown local attention engine {local!r}")
-    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
     sq = q_blk.shape[0]
     my = jax.lax.axis_index(axis)
     q_pos = my * sq + jnp.arange(sq)            # global query positions
@@ -319,8 +350,9 @@ def ring_attention_local(q_blk, k_blk, v_blk, *, axis: str,
         # 1..n_dev-1, the local block is accumulated outside — so no
         # final wasted KV rotation ships around the ring.
         k_cur, v_cur, src_dev, m, l, o = carry
-        k_cur = jax.lax.ppermute(k_cur, axis, perm)
-        v_cur = jax.lax.ppermute(v_cur, axis, perm)
+        k_cur, v_cur = _kv_rotate(k_cur, v_cur, axis=axis, n_dev=n_dev,
+                                  use_dma_ring=use_dma_ring,
+                                  interpret=interpret)
         src_dev = (src_dev - 1) % n_dev
         m, l, o = accumulate(k_cur, v_cur, src_dev, m, l, o)
         return (k_cur, v_cur, src_dev, m, l, o), None
@@ -335,7 +367,8 @@ def ring_attention_local(q_blk, k_blk, v_blk, *, axis: str,
 
 
 def _build_ring_attention(mesh, axis: str, causal: bool,
-                          local: str = "xla", interpret: bool = False):
+                          local: str = "xla", interpret: bool = False,
+                          use_dma_ring: bool = False):
     import functools
 
     import jax
@@ -345,6 +378,7 @@ def _build_ring_attention(mesh, axis: str, causal: bool,
     body = functools.partial(
         ring_attention_local, axis=axis, n_devices=mesh.shape[axis],
         causal=causal, local=local, interpret=interpret,
+        use_dma_ring=use_dma_ring,
     )
 
     spec = P(axis)
@@ -366,25 +400,30 @@ def ring_attention(
     causal: bool = False,
     local: str = "xla",
     interpret: bool = False,
+    use_dma_ring: bool = False,
 ):
     """Exact attention with sequence sharded over the mesh.
 
     q, k, v: (seq, heads, head_dim) — ``seq`` must divide evenly over the
     axis. Returns (seq, heads, head_dim) with the same sharding.
     ``local="flash"`` runs the Pallas flash kernels as the per-device
-    block (``interpret=True`` for CPU-mesh testing). The compiled
-    program is cached per (mesh, axis, causal, local, interpret);
-    shapes re-use jit's own cache.
+    block (``interpret=True`` for CPU-mesh testing).
+    ``use_dma_ring=True`` rotates KV with the Pallas async remote-DMA
+    exchange instead of ``ppermute`` (forward-only — see
+    :func:`ring_attention_local`). The compiled program is cached per
+    (mesh, axis, causal, local, interpret, use_dma_ring); shapes re-use
+    jit's own cache.
     """
     from fiber_tpu.parallel.mesh import default_mesh
 
     mesh = mesh or default_mesh()
     # Mesh hashes by value (devices + axis names): no id-aliasing after GC,
     # and equal meshes share the compiled program.
-    key = (mesh, axis, causal, local, interpret)
+    key = (mesh, axis, causal, local, interpret, use_dma_ring)
     fn = _compiled_cache.get(key)
     if fn is None:
-        fn = _build_ring_attention(mesh, axis, causal, local, interpret)
+        fn = _build_ring_attention(mesh, axis, causal, local, interpret,
+                                   use_dma_ring)
         _compiled_cache[key] = fn
     return fn(q, k, v)
 

@@ -34,7 +34,8 @@ _compiled_cache: dict = {}
 
 def ulysses_attention_local(q_blk, k_blk, v_blk, *, axis: str,
                             causal: bool = False,
-                            local: str = "reference"):
+                            local: str = "reference",
+                            use_dma_ring: bool = False):
     """The raw per-device Ulysses body, for COMPOSITION inside a
     caller's own ``shard_map`` (the all-to-alls bind by axis NAME, so
     it composes with other mesh axes exactly like
@@ -62,9 +63,7 @@ def ulysses_attention_local(q_blk, k_blk, v_blk, *, axis: str,
     # (seq, heads/n, head_dim); every device now sees the whole
     # sequence for its head slice.
     def seq_to_heads(x):
-        return jax.lax.all_to_all(
-            x, axis, split_axis=1, concat_axis=0, tiled=True
-        )
+        return _a2a(x, axis, 1, 0, use_dma_ring)
 
     qh = seq_to_heads(q_blk)
     kh = seq_to_heads(k_blk)
@@ -85,12 +84,30 @@ def ulysses_attention_local(q_blk, k_blk, v_blk, *, axis: str,
         out = reference_attention(qh, kh, vh, causal=causal)
     # all-to-all #2: scatter sequence, gather heads — back to the
     # input layout.
+    return _a2a(out, axis, 0, 1, use_dma_ring)
+
+
+def _a2a(x, axis: str, split_axis: int, concat_axis: int,
+         use_dma_ring: bool):
+    """The tiled all-to-all both Ulysses swaps run: XLA's native
+    collective by default, or the Pallas async remote-DMA ring
+    (ops/dma_ring — forward-only, interpreter fallback off-TPU) when
+    ``use_dma_ring`` is set."""
+    import jax
+
+    if use_dma_ring:
+        from fiber_tpu.ops.dma_ring import ring_all_to_all
+
+        return ring_all_to_all(x, axis=axis, split_axis=split_axis,
+                               concat_axis=concat_axis)
     return jax.lax.all_to_all(
-        out, axis, split_axis=0, concat_axis=1, tiled=True
+        x, axis, split_axis=split_axis, concat_axis=concat_axis,
+        tiled=True,
     )
 
 
-def _build(mesh, axis: str, causal: bool, local: str):
+def _build(mesh, axis: str, causal: bool, local: str,
+           use_dma_ring: bool = False):
     import functools
 
     import jax
@@ -98,7 +115,8 @@ def _build(mesh, axis: str, causal: bool, local: str):
     from jax.sharding import PartitionSpec as P
 
     local_fn = functools.partial(
-        ulysses_attention_local, axis=axis, causal=causal, local=local
+        ulysses_attention_local, axis=axis, causal=causal, local=local,
+        use_dma_ring=use_dma_ring,
     )
 
     spec = P(axis)
@@ -110,16 +128,19 @@ def _build(mesh, axis: str, causal: bool, local: str):
 
 
 def ulysses_attention(q, k, v, mesh=None, axis: str = "pool",
-                      causal: bool = False, local: str = "reference"):
+                      causal: bool = False, local: str = "reference",
+                      use_dma_ring: bool = False):
     """Exact attention with the sequence dim sharded over ``axis``.
 
     q, k, v: (seq, heads, head_dim); ``seq`` and ``heads`` must both
     divide evenly by the mesh axis size. Returns (seq, heads, head_dim)
     with the same sharding. ``local`` picks the per-device attention
     (see :func:`ulysses_attention_local`) — ``"blockwise"`` or
-    ``"flash"`` lift the O(S^2) local-memory constraint. Mesh keys hash
-    by value, so the compiled program is shared across equal meshes
-    (no id-aliasing)."""
+    ``"flash"`` lift the O(S^2) local-memory constraint.
+    ``use_dma_ring=True`` runs both swaps over the Pallas async
+    remote-DMA ring (forward-only; numerics pinned against the native
+    collective in tests). Mesh keys hash by value, so the compiled
+    program is shared across equal meshes (no id-aliasing)."""
     from fiber_tpu.parallel.mesh import default_mesh
 
     mesh = mesh or default_mesh()
@@ -134,9 +155,9 @@ def ulysses_attention(q, k, v, mesh=None, axis: str = "pool",
             f"ulysses needs heads % n_dev == 0 (got {heads} heads over "
             f"{n_dev} devices); use ring_attention for odd head counts"
         )
-    key = (mesh, axis, causal, local)
+    key = (mesh, axis, causal, local, use_dma_ring)
     fn = _compiled_cache.get(key)
     if fn is None:
-        fn = _build(mesh, axis, causal, local)
+        fn = _build(mesh, axis, causal, local, use_dma_ring)
         _compiled_cache[key] = fn
     return fn(q, k, v)

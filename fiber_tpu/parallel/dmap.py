@@ -62,16 +62,26 @@ def _fingerprint(fn: Callable, mesh) -> str:
 
 
 def _compiled_mapper(fn: Callable, mesh, multi_arg: bool,
-                     donate: bool = False):
+                     donate: bool = False,
+                     bcast_positions: tuple = ()):
     """jit(shard_map(vmap(fn))) over the pool axis, cached per
-    (fn, mesh, donate)."""
+    (fn, mesh, donate, bcast_positions).
+
+    ``bcast_positions`` (multi_arg only) names positional-arg slots the
+    caller strips out of the stacked items and passes ONCE, unbatched:
+    they enter vmap with ``in_axes=None`` and shard_map with a
+    replicated ``P()`` spec, so a device-resident replicated array
+    (the store's device tier) flows straight in with zero per-call H2D
+    — the device-native broadcast path (docs/objectstore.md)."""
     import jax
     from jax.sharding import PartitionSpec as P
     from fiber_tpu.utils.jaxcompat import shard_map
 
+    bcast_positions = tuple(sorted(int(p) for p in bcast_positions))
+    nb = len(bcast_positions)
     try:
         hash(fn)
-        key = (fn, mesh, multi_arg, donate)
+        key = (fn, mesh, multi_arg, donate, bcast_positions)
     except TypeError:
         key = None  # unhashable callable: compile uncached
     if key is not None:
@@ -88,21 +98,31 @@ def _compiled_mapper(fn: Callable, mesh, multi_arg: bool,
 
     DEVICE.note_compile(_fingerprint(fn, mesh))
 
-    if multi_arg:
+    if multi_arg and nb:
+        def per_item(packed, *bc):
+            # Re-interleave the broadcast args at their original call
+            # positions (ascending insert keeps later indices honest).
+            args = list(packed)
+            for pos, arg in zip(bcast_positions, bc):
+                args.insert(pos, arg)
+            return fn(*args)
+    elif multi_arg:
         def per_item(packed):
             return fn(*packed)
     else:
         per_item = fn
 
-    local = jax.vmap(per_item)
+    local = jax.vmap(per_item, in_axes=(0,) + (None,) * nb)
     spec = P("pool")
     mapped = shard_map(
-        local, mesh=mesh, in_specs=(spec,), out_specs=spec,
+        local, mesh=mesh,
+        in_specs=(spec,) + (P(),) * nb,
+        out_specs=spec,
         check_vma=False,
     )
 
-    def run(batched):
-        return mapped(batched)
+    def run(batched, *bc):
+        return mapped(batched, *bc)
 
     compiled = jax.jit(run, donate_argnums=(0,) if donate else ())
     if key is not None:
@@ -130,7 +150,8 @@ class DeviceMapPlan:
     """
 
     def __init__(self, fn: Callable, mesh=None, star: bool = False,
-                 donate: bool = False) -> None:
+                 donate: bool = False, broadcast: tuple = (),
+                 broadcast_positions: tuple = ()) -> None:
         import numpy as np
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -140,10 +161,26 @@ class DeviceMapPlan:
         self.mesh = mesh or default_mesh()
         self.star = star
         self.donate = donate
+        # Broadcast args (star only): passed ONCE per call, replicated
+        # over the mesh rather than stacked with the items. Callers
+        # hand the items with these positions already stripped; pool's
+        # device path resolves them through the store's device tier so
+        # repeat generations re-use the resident replicated arrays.
+        self.broadcast = tuple(broadcast)
+        self.broadcast_positions = tuple(
+            sorted(int(p) for p in broadcast_positions))
+        if len(self.broadcast) != len(self.broadcast_positions):
+            raise ValueError(
+                "broadcast and broadcast_positions must pair up "
+                f"({len(self.broadcast)} args, "
+                f"{len(self.broadcast_positions)} positions)")
+        if self.broadcast and not star:
+            raise ValueError("broadcast args require star=True")
         self._n_dev = int(np.prod(list(self.mesh.shape.values())))
         self._sharding = NamedSharding(self.mesh, P("pool"))
-        self._compiled = _compiled_mapper(fn, self.mesh, multi_arg=star,
-                                          donate=donate)
+        self._compiled = _compiled_mapper(
+            fn, self.mesh, multi_arg=star, donate=donate,
+            bcast_positions=self.broadcast_positions)
 
     def __call__(self, iterable: Iterable[Any]) -> List[Any]:
         import jax
@@ -177,7 +214,7 @@ class DeviceMapPlan:
                 lambda a: jax.device_put(np.asarray(a), self._sharding),
                 batched,
             )
-        out = self._compiled(device_in)
+        out = self._compiled(device_in, *self.broadcast)
         host = jax.device_get(out)
         if not isinstance(host, (np.ndarray, np.generic)):
             return [jax.tree.map(lambda a: a[i], host) for i in range(n)]
@@ -189,12 +226,17 @@ def device_map(
     iterable: Iterable[Any],
     mesh=None,
     star: bool = False,
+    broadcast: tuple = (),
+    broadcast_positions: tuple = (),
 ) -> List[Any]:
     """Map a pure jittable function over items on the device mesh.
 
     Items may be scalars, arrays, or pytrees of arrays (all with identical
     structure/shapes). With ``star=True`` each item is a tuple of
-    positional args. Returns a list of host (numpy) results in order.
+    positional args. ``broadcast``/``broadcast_positions`` (star only)
+    pass shared args once, replicated over the mesh, instead of stacked
+    per item — items must already have those positions stripped.
+    Returns a list of host (numpy) results in order.
     One-shot form of :class:`DeviceMapPlan` (the compiled program is
     still cached across calls; the plan additionally pins the
     mesh/sharding resolution and offers input-buffer donation).
@@ -207,7 +249,8 @@ def device_map(
         # Before any mesh/compile work: an empty map must stay a no-op
         # (no backend resolution, no compile-cache entry pinning fn).
         return []
-    return DeviceMapPlan(fn, mesh=mesh, star=star)(iterable)
+    return DeviceMapPlan(fn, mesh=mesh, star=star, broadcast=broadcast,
+                         broadcast_positions=broadcast_positions)(iterable)
 
 
 def clear_device_map_cache() -> None:

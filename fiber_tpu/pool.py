@@ -77,6 +77,10 @@ _g_inflight = telemetry.gauge(
 
 DEFAULT_CHUNKSIZE = 32
 MAX_INFLIGHT_TASKS = 20000
+# Smallest shared array the device map lifts onto the mesh as a
+# broadcast arg (docs/objectstore.md "Device tier"): under this, the
+# stack-and-shard path is cheaper than content-addressing.
+_DEVICE_BCAST_MIN = 64 << 10
 
 #: Process-wide map-id source for accounting billing keys: unique per
 #: submitted map across every pool in this master process.
@@ -510,13 +514,18 @@ def _chunk_has_refs(chunk: List[Any]) -> bool:
 def _resolve_item(item: Any, client) -> Any:
     """Replace ObjectRefs (top level, or one tuple level deep — exactly
     where the encoder puts them) with the resolved objects. Raises
-    StoreFetchError when a ref cannot be resolved from any tier."""
+    StoreFetchError when a ref cannot be resolved from any tier.
+    Device-hinted refs resolve through the store's device tier, so
+    co-located workers share one replicated copy per digest."""
     if isinstance(item, ObjectRef):
-        return client.resolve(item)
+        return client.resolve(
+            item, device=getattr(item, "device_hint", False))
     if type(item) is tuple and any(
             isinstance(e, ObjectRef) for e in item):
-        return tuple(client.resolve(e) if isinstance(e, ObjectRef) else e
-                     for e in item)
+        return tuple(
+            client.resolve(e, device=getattr(e, "device_hint", False))
+            if isinstance(e, ObjectRef) else e
+            for e in item)
     return item
 
 
@@ -1814,24 +1823,31 @@ class Pool:
 
     # -- by-reference payloads (fiber_tpu/store) ---------------------------
     def _encode_items(self, items: List[Any], seq_digests: List[str],
-                      bkey=None) -> List[Any]:
+                      bkey=None, device_hint: bool = False) -> List[Any]:
         """Replace large args with ObjectRefs (top level and one tuple
         level deep, which covers map-over-tuples and starmap). The memo
         keys on object identity so the classic broadcast pattern — the
         same params object in every item — is hashed and stored ONCE
         per map, not once per task. ``bkey`` bills each stored payload
-        to the submitting map (accounting plane)."""
+        to the submitting map (accounting plane); ``device_hint`` marks
+        the refs device-destined so resolving workers route them
+        through the shared device tier (one H2D per host per digest)."""
         memo: Dict[int, Tuple[Any, Any]] = {}
-        return [self._encode_item(it, memo, seq_digests, bkey)
+        return [self._encode_item(it, memo, seq_digests, bkey,
+                                  device_hint)
                 for it in items]
 
-    def _encode_item(self, item, memo, seq_digests, bkey=None):
+    def _encode_item(self, item, memo, seq_digests, bkey=None,
+                     device_hint: bool = False):
         if type(item) is tuple:
-            return tuple(self._encode_obj(e, memo, seq_digests, bkey)
+            return tuple(self._encode_obj(e, memo, seq_digests, bkey,
+                                          device_hint)
                          for e in item)
-        return self._encode_obj(item, memo, seq_digests, bkey)
+        return self._encode_obj(item, memo, seq_digests, bkey,
+                                device_hint)
 
-    def _encode_obj(self, obj, memo, seq_digests, bkey=None):
+    def _encode_obj(self, obj, memo, seq_digests, bkey=None,
+                    device_hint: bool = False):
         if isinstance(obj, ObjectRef):
             return obj  # user pre-put it; ships as-is
         key = id(obj)
@@ -1850,6 +1866,7 @@ class Pool:
             return obj
         ref = self._objstore.put_bytes(data, refs=1,
                                        owner=self._store_addr)
+        ref.device_hint = device_hint
         seq_digests.append(ref.digest)
         if bkey is not None:
             COSTS.charge(bkey, store_put_bytes=len(data))
@@ -2577,11 +2594,18 @@ class Pool:
                 enc_items = items
                 if self._objstore is not None and self._store_inline_max:
                     seq_digests: List[str] = []
+                    # Accelerator-destined maps (@meta tpu/gpu/device)
+                    # mark their refs so resolving workers route them
+                    # through the shared device tier — one H2D per host
+                    # per digest, not per worker.
+                    fmeta = get_meta(func)
+                    dev_hint = bool(fmeta.get("tpu") or fmeta.get("gpu")
+                                    or fmeta.get("device"))
                     try:
                         with global_timer.section("pool.store_encode"):
-                            enc_items = self._encode_items(items,
-                                                           seq_digests,
-                                                           env_key)
+                            enc_items = self._encode_items(
+                                items, seq_digests, env_key,
+                                device_hint=dev_hint)
                     except Exception:  # noqa: BLE001 - optimization only
                         logger.warning(
                             "store: arg encoding failed; shipping inline",
@@ -2694,7 +2718,14 @@ class Pool:
                 "device path"
             ) from err
         t0 = time.perf_counter()
-        out = device_map(func, items, star=star)
+        items, bcast, bpos = self._device_broadcast_split(items, star)
+        if bcast:
+            out = device_map(func, items, star=star, broadcast=bcast,
+                             broadcast_positions=bpos)
+        else:
+            # No split: keep the pre-device-tier call shape so stubs
+            # and older device_map signatures stay compatible.
+            out = device_map(func, items, star=star)
         wall = time.perf_counter() - t0
         flops_meta = get_meta(func).get("flops")
         if COSTS.enabled and items:
@@ -2723,6 +2754,73 @@ class Pool:
             DEVICE.note_map_flops(float(flops_meta) * len(items),
                                   wall, len(items))
         return out
+
+    def _device_broadcast_split(
+        self, items: List[Any], star: bool
+    ) -> "Tuple[List[Any], tuple, tuple]":
+        """Detect broadcast args in a device map and lift them onto the
+        mesh ONCE (docs/objectstore.md "Device tier").
+
+        A position of every star-tuple holding the IDENTICAL array
+        object (id-identity — the ES/POET idiom ``[(params, s) for s
+        in seeds]``) is a broadcast: instead of stacking pop-size
+        copies and paying pop-size x nbytes of H2D per call, the array
+        is content-addressed, replicated across the mesh through the
+        store's device tier (accounted under the ``ici`` site), and
+        passed unbatched. Repeat generations with the same digest hit
+        the tier: zero wire bytes, zero H2D. Returns ``(items with the
+        positions stripped, broadcast args, positions)`` — unchanged
+        inputs when nothing qualifies. With the tier off/demoted the
+        qualifying args still pass unbatched (never stacked) but
+        un-cached: every call re-pays the mesh transfer."""
+        if not star or len(items) < 2:
+            return items, (), ()
+        first = items[0]
+        if not isinstance(first, tuple) or len(first) < 2:
+            return items, (), ()
+        import numpy as np
+
+        width = len(first)
+        positions = []
+        for j in range(width):
+            cand = first[j]
+            if not isinstance(cand, np.ndarray) or \
+                    cand.nbytes < _DEVICE_BCAST_MIN:
+                continue
+            if all(isinstance(it, tuple) and len(it) == width
+                   and it[j] is cand for it in items):
+                positions.append(j)
+        # At least one per-item position must remain — an all-broadcast
+        # map has nothing to shard over the pool axis.
+        if not positions or len(positions) == width:
+            return items, (), ()
+        from fiber_tpu import store as storemod
+        from fiber_tpu.store.core import digest_of
+
+        tier = storemod.device_store_tier()
+        bcast = []
+        digests = []
+        for j in positions:
+            arr = first[j]
+            if tier is None:
+                bcast.append(arr)
+                continue
+            head = f"{arr.dtype}|{arr.shape}|".encode()
+            dig = digest_of(head + np.ascontiguousarray(arr).tobytes())
+            bcast.append(tier.put(dig, arr))
+            digests.append(dig)
+        if digests:
+            # Locality seed: the scheduler's host->digest map learns
+            # this host holds the broadcast content, so a host-path map
+            # of the same payload prefers these workers.
+            try:
+                self._sched.note_host_has(local_host_key(), digests)
+            except Exception:  # noqa: BLE001 - placement hint only
+                pass
+        pos_set = set(positions)
+        stripped = [tuple(a for j, a in enumerate(it)
+                          if j not in pos_set) for it in items]
+        return stripped, tuple(bcast), tuple(positions)
 
     def _dispatch_async(self, func, items, star, chunksize,
                         callback, error_callback, priority=1.0,

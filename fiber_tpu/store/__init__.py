@@ -35,6 +35,31 @@ _lock = threading.Lock()
 _store: Optional[LocalStore] = None
 _server: Optional[StoreServer] = None
 _client: Optional[StoreClient] = None
+_dtier = None  # DeviceTier | None — peek convention, never instantiate
+
+
+def device_store_tier():
+    """The process-wide DeviceTier (docs/objectstore.md "Device tier"),
+    built from config on first use; None when `store_device_enabled`
+    is off. Per device-owning process — on TPU that IS per host.
+    (Named apart from the ``store.device_tier`` SUBMODULE: importing
+    that module rebinds the package attribute of the same name, so an
+    accessor called ``device_tier`` would shadow itself on first use.)"""
+    global _dtier
+    with _lock:
+        from fiber_tpu import config
+
+        cfg = config.get()
+        if not bool(cfg.store_device_enabled):
+            # Live knob: an already-built tier is withheld (not torn
+            # down) while disabled, so re-enabling keeps its contents.
+            return None
+        if _dtier is None:
+            from fiber_tpu.store.device_tier import DeviceTier
+
+            _dtier = DeviceTier(
+                capacity_bytes=int(cfg.store_device_capacity_mb) << 20)
+        return _dtier
 
 
 def local_store() -> LocalStore:
@@ -75,10 +100,13 @@ def client() -> StoreClient:
 
 def reset(close: bool = True) -> None:
     """Drop the singletons (tests: rebuild against fresh config)."""
-    global _store, _server, _client
+    global _store, _server, _client, _dtier
     with _lock:
         store, server, cli = _store, _server, _client
-        _store = _server = _client = None
+        dtier = _dtier
+        _store = _server = _client = _dtier = None
+    if dtier is not None:
+        dtier.clear()
     if close:
         if server is not None:
             server.close()

@@ -67,17 +67,27 @@ class ObjectRef:
     """By-reference handle to one stored payload: content ``digest``
     (hex sha256), serialized ``size`` in bytes, and the ``owner`` store
     address (``tcp://ip:port``) that is guaranteed to be able to serve
-    it. Tiny and picklable — this is what rides task/result frames."""
+    it. Tiny and picklable — this is what rides task/result frames.
 
-    __slots__ = ("digest", "size", "owner")
+    ``device_hint`` marks a device-destined payload (the map function's
+    @meta asks for an accelerator): the resolving worker routes it
+    through the store's DEVICE tier (docs/objectstore.md "Device
+    tier"), so one host pays one H2D per digest no matter how many
+    co-located workers resolve it. A hint, never a requirement —
+    resolution without a tier is the ordinary host path."""
 
-    def __init__(self, digest: str, size: int, owner: str = "") -> None:
+    __slots__ = ("digest", "size", "owner", "device_hint")
+
+    def __init__(self, digest: str, size: int, owner: str = "",
+                 device_hint: bool = False) -> None:
         self.digest = digest
         self.size = int(size)
         self.owner = owner
+        self.device_hint = bool(device_hint)
 
     def __reduce__(self):
-        return (ObjectRef, (self.digest, self.size, self.owner))
+        return (ObjectRef,
+                (self.digest, self.size, self.owner, self.device_hint))
 
     def __eq__(self, other) -> bool:
         return (isinstance(other, ObjectRef)

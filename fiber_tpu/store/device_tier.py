@@ -1,0 +1,355 @@
+"""Device-resident store tier: broadcast payloads live ON the mesh
+(docs/objectstore.md "Device tier").
+
+The host object store (core.py / plane.py) ends every resolution at the
+host->device boundary: a worker that resolves a broadcast ref holds host
+bytes, and each ``jax.device_put`` re-pays PCIe/H2D for content the
+chips already saw last generation. This tier closes that gap: a bounded
+LRU of ``digest -> (device-resident pytree, per-leaf sharding
+metadata)`` so the resolution order becomes **device tier -> host RAM ->
+disk -> wire**. An ES/POET master that re-broadcasts the same params
+digest pays ZERO wire bytes and ZERO H2D on repeats — the replicated
+``jax.Array`` is handed straight back.
+
+Placement traffic is accounted honestly through the device telemetry
+plane under the new ``ici`` transfer site (``DEVICE.transfer``): one
+host->device ingest plus the ``(n_dev - 1) x nbytes`` mesh fan-out per
+put, so ``Pool.cost()`` and ``fiber-tpu explain`` can split transfer
+blame between ICI bytes and wire bytes.
+
+Capacity discipline mirrors :class:`fiber_tpu.store.core.LocalStore`:
+``refs`` are lifecycle hints, ``pins`` are hard (a pinned entry is never
+evicted), and eviction walks LRU order. Unlike the host store, eviction
+never *loses* data — the host tiers still hold the serialized bytes, so
+dropping a device copy only costs the next resolution one H2D.
+
+The ``hbm_fill`` watchdog rule (telemetry/monitor.py) DEMOTES the tier
+under HBM pressure — the first closed-loop remediation in the stack:
+every entry is dropped, a ``store``/``remediate`` flight event records
+the action, and resolutions fall through to the host tiers with zero
+lost tasks until the rule clears and the tier re-promotes.
+
+Per-process by design: a ``jax.Array`` cannot be shared across OS
+processes, but on TPU one process owns a host's chips — so per
+device-owning process IS per host, and co-located host-plane workers
+(which never device_put) are unaffected.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from fiber_tpu import telemetry
+from fiber_tpu.telemetry.flightrec import FLIGHT
+from fiber_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+# Registry twins (docs/observability.md metric catalog): the same
+# counters ``stats()`` exposes, mirrored so cluster_metrics / the
+# Prometheus endpoint see device-tier behavior without a store RPC.
+_m_dev_puts = telemetry.counter(
+    "store_device_puts", "Objects placed into the device store tier")
+_m_dev_hits = telemetry.counter(
+    "store_device_hits", "Device store tier resolution hits")
+_m_dev_evictions = telemetry.counter(
+    "store_device_evictions",
+    "Device store tier entries dropped, by cause")
+_g_dev_bytes = telemetry.gauge(
+    "store_device_bytes", "Device store tier resident bytes")
+
+
+def _leaf_meta(leaf) -> Optional[Dict[str, Any]]:
+    """Sharding metadata for one device-resident leaf: shape/dtype plus
+    the NamedSharding spec when the array carries one (None fields are
+    honest — a committed single-device array has no named spec)."""
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return None
+    meta: Dict[str, Any] = {
+        "shape": tuple(shape), "dtype": str(dtype),
+        "nbytes": int(getattr(leaf, "nbytes", 0)),
+        "sharding": None, "replicated": None,
+    }
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is not None:
+        spec = getattr(sharding, "spec", None)
+        meta["sharding"] = str(spec) if spec is not None else \
+            type(sharding).__name__
+        try:
+            meta["replicated"] = bool(
+                sharding.is_fully_replicated)
+        except Exception:  # noqa: BLE001 - exotic sharding objects
+            pass
+    return meta
+
+
+class _DevEntry:
+    __slots__ = ("obj", "nbytes", "refs", "pins", "meta")
+
+    def __init__(self, obj: Any, nbytes: int, refs: int,
+                 meta: List[Optional[Dict[str, Any]]]) -> None:
+        self.obj = obj
+        self.nbytes = int(nbytes)
+        self.refs = int(refs)
+        self.pins = 0
+        self.meta = meta
+
+
+class DeviceTier:
+    """HBM-budgeted LRU of digest -> device-resident object; see module
+    docstring. All jax imports are lazy — building the tier in a
+    process that never resolves device payloads costs nothing."""
+
+    def __init__(self, capacity_bytes: int = 256 << 20,
+                 mesh=None) -> None:
+        self.capacity = int(capacity_bytes)
+        self.mesh = mesh  # None = fiber_tpu.parallel default mesh
+        self._lock = threading.RLock()
+        self._entries: "Dict[str, _DevEntry]" = {}
+        self._order: List[str] = []  # LRU: oldest first
+        self._demoted = False
+        self._demote_reason = ""
+        self._stats: Dict[str, int] = {
+            "puts": 0, "hits": 0, "misses": 0, "evictions": 0,
+            "bytes": 0, "demotions": 0, "put_dedup_hits": 0,
+        }
+
+    # -- placement ------------------------------------------------------
+    def _mesh(self):
+        if self.mesh is not None:
+            return self.mesh
+        from fiber_tpu.parallel.mesh import default_mesh
+
+        return default_mesh()
+
+    def _n_dev(self, mesh) -> int:
+        try:
+            n = 1
+            for v in mesh.shape.values():
+                n *= int(v)
+            return max(1, n)
+        except Exception:  # noqa: BLE001 - exotic mesh objects
+            return 1
+
+    def _replicate(self, host_leaf, mesh):
+        """One H2D to the first mesh device, then the ICI fan-out —
+        :func:`fiber_tpu.ops.collectives.broadcast_to_mesh`."""
+        from fiber_tpu.ops.collectives import broadcast_to_mesh
+
+        return broadcast_to_mesh(host_leaf, mesh)
+
+    def put(self, digest: str, obj: Any,
+            refs: int = 0) -> Any:
+        """Place ``obj`` (a host pytree) into the tier under ``digest``:
+        every array leaf is replicated across the mesh; the device-
+        resident pytree is returned (and cached). A demoted or
+        zero-capacity tier returns ``obj`` untouched — callers never
+        need to care. Placement bytes account under the ``ici`` site:
+        ingest (1x) + mesh fan-out ((n_dev - 1)x)."""
+        with self._lock:
+            if self._demoted or self.capacity <= 0:
+                return obj
+            entry = self._entries.get(digest)
+            if entry is not None:
+                self._stats["put_dedup_hits"] += 1
+                self._touch(digest)
+                return entry.obj
+        import jax
+        import numpy as np
+
+        from fiber_tpu.telemetry.device import DEVICE
+
+        mesh = self._mesh()
+        n_dev = self._n_dev(mesh)
+        leaves, treedef = jax.tree.flatten(obj)
+        nbytes = sum(int(getattr(np.asarray(leaf), "nbytes", 0))
+                     for leaf in leaves
+                     if isinstance(leaf, (np.ndarray, np.generic))
+                     or hasattr(leaf, "__jax_array__")
+                     or hasattr(leaf, "sharding"))
+        # Honest accounting: the ingest H2D plus the ICI fan-out to the
+        # other devices, under the site explain/cost split on.
+        with DEVICE.transfer("ici", nbytes * n_dev):
+            dev_leaves = [
+                self._replicate(leaf, mesh)
+                if (isinstance(leaf, (np.ndarray, np.generic))
+                    and getattr(leaf, "ndim", 0) > 0)
+                or hasattr(leaf, "sharding")
+                else leaf
+                for leaf in leaves
+            ]
+        dev_obj = jax.tree.unflatten(treedef, dev_leaves)
+        meta = [_leaf_meta(leaf) for leaf in dev_leaves]
+        with self._lock:
+            if self._demoted:
+                return dev_obj  # raced a demotion: hand back, don't cache
+            existing = self._entries.get(digest)
+            if existing is not None:
+                self._stats["put_dedup_hits"] += 1
+                self._touch(digest)
+                return existing.obj
+            self._entries[digest] = _DevEntry(dev_obj, nbytes, refs, meta)
+            self._order.append(digest)
+            self._stats["puts"] += 1
+            self._stats["bytes"] += nbytes
+            self._evict_locked()
+            _g_dev_bytes.set(float(self._stats["bytes"]))
+        _m_dev_puts.inc()
+        return dev_obj
+
+    def get(self, digest: str, pin: bool = False) -> Optional[Any]:
+        """The device-resident object for ``digest``, or None (miss /
+        demoted). A hit refreshes LRU order."""
+        with self._lock:
+            if self._demoted:
+                return None
+            entry = self._entries.get(digest)
+            if entry is None:
+                self._stats["misses"] += 1
+                return None
+            if pin:
+                entry.pins += 1
+            self._touch(digest)
+            self._stats["hits"] += 1
+        _m_dev_hits.inc()
+        return entry.obj
+
+    def meta(self, digest: str) -> Optional[List[Optional[Dict[str, Any]]]]:
+        """Per-leaf sharding metadata of a resident entry (shape, dtype,
+        NamedSharding spec, replication), or None on miss."""
+        with self._lock:
+            entry = self._entries.get(digest)
+            return None if entry is None else list(entry.meta)
+
+    def contains(self, digest: str) -> bool:
+        with self._lock:
+            return not self._demoted and digest in self._entries
+
+    # -- lifecycle (LocalStore parity) ----------------------------------
+    def add_ref(self, digest: str, n: int = 1) -> None:
+        with self._lock:
+            entry = self._entries.get(digest)
+            if entry is not None:
+                entry.refs += n
+
+    def release(self, digest: str, n: int = 1) -> None:
+        with self._lock:
+            entry = self._entries.get(digest)
+            if entry is not None:
+                entry.refs = max(0, entry.refs - n)
+
+    def unpin(self, digest: str) -> None:
+        with self._lock:
+            entry = self._entries.get(digest)
+            if entry is not None:
+                entry.pins = max(0, entry.pins - 1)
+
+    def delete(self, digest: str) -> None:
+        with self._lock:
+            self._drop_locked(digest, cause="delete")
+            _g_dev_bytes.set(float(self._stats["bytes"]))
+
+    def _touch(self, digest: str) -> None:
+        try:
+            self._order.remove(digest)
+        except ValueError:
+            pass
+        self._order.append(digest)
+
+    def _drop_locked(self, digest: str, cause: str) -> None:
+        entry = self._entries.pop(digest, None)
+        if entry is None:
+            return
+        try:
+            self._order.remove(digest)
+        except ValueError:
+            pass
+        self._stats["bytes"] = max(0, self._stats["bytes"] - entry.nbytes)
+        self._stats["evictions"] += 1
+        _m_dev_evictions.inc(cause=cause)
+
+    def _evict_locked(self) -> None:
+        """LRU walk past capacity. Pins are untouchable; refs do NOT
+        protect (unlike the host store there is nothing to spill — the
+        host tiers still hold the bytes, so dropping a device copy only
+        costs the next resolution one H2D)."""
+        if self._stats["bytes"] <= self.capacity:
+            return
+        for digest in list(self._order):
+            if self._stats["bytes"] <= self.capacity:
+                break
+            entry = self._entries.get(digest)
+            if entry is None or entry.pins > 0:
+                continue
+            self._drop_locked(digest, cause="capacity")
+
+    # -- closed-loop remediation (hbm_fill watchdog rule) ----------------
+    def demote(self, reason: str = "hbm_fill") -> int:
+        """Drop every unpinned entry and stop admitting new ones — the
+        ``hbm_fill`` remediation (telemetry/monitor.py). Returns the
+        bytes freed. Resolutions fall through to host RAM/disk/wire, so
+        in-flight maps lose nothing; flight-evented so the postmortem
+        trail shows the watchdog ACTED, not just observed."""
+        with self._lock:
+            if self._demoted:
+                return 0
+            freed = 0
+            dropped = 0
+            for digest in list(self._order):
+                entry = self._entries.get(digest)
+                if entry is None or entry.pins > 0:
+                    continue
+                freed += entry.nbytes
+                dropped += 1
+                self._drop_locked(digest, cause="demote")
+            self._demoted = True
+            self._demote_reason = str(reason)
+            self._stats["demotions"] += 1
+            _g_dev_bytes.set(float(self._stats["bytes"]))
+        FLIGHT.record("store", "remediate", rule=str(reason),
+                      action="demote_device_tier", dropped=dropped,
+                      bytes=freed)
+        logger.warning(
+            "store: device tier demoted to host RAM (%s) — dropped %d "
+            "entries / %d bytes; resolutions fall through to the host "
+            "tiers", reason, dropped, freed)
+        return freed
+
+    def promote(self) -> None:
+        """Re-admit entries (the breach cleared). Flight-evented like
+        the demotion so the remediation window is visible end to end."""
+        with self._lock:
+            if not self._demoted:
+                return
+            self._demoted = False
+            reason, self._demote_reason = self._demote_reason, ""
+        FLIGHT.record("store", "remediate", rule=reason,
+                      action="promote_device_tier")
+        logger.info("store: device tier re-promoted (%s cleared)", reason)
+
+    @property
+    def demoted(self) -> bool:
+        with self._lock:
+            return self._demoted
+
+    # -- read side ------------------------------------------------------
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            out = dict(self._stats)
+            out["entries"] = len(self._entries)
+            out["capacity_bytes"] = self.capacity
+            out["demoted"] = int(self._demoted)
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._order.clear()
+            self._demoted = False
+            self._demote_reason = ""
+            for key in self._stats:
+                self._stats[key] = 0
+            _g_dev_bytes.set(0.0)

@@ -262,11 +262,31 @@ class StoreClient:
             _m_store_ops.inc(n, op=key, side="client")
 
     # -- resolution -----------------------------------------------------
-    def resolve(self, ref: ObjectRef) -> Any:
+    def resolve(self, ref: ObjectRef, device: bool = False) -> Any:
+        """Deserialized object for ``ref``. With ``device=True`` the
+        payload is device-destined: the resolution order gains a fourth,
+        fastest tier in front of RAM/disk/wire — the device-resident
+        store (docs/objectstore.md "Device tier"). A tier hit returns
+        the already-replicated ``jax.Array`` pytree: zero wire bytes,
+        zero H2D; a miss fills the tier so the NEXT resolution (this
+        process or a co-located pool on the same chips) is free. The
+        tier is a no-op when disabled, demoted by the ``hbm_fill``
+        watchdog, or on a pure host plane."""
         self._count("resolves")
+        if device:
+            tier = self._device_tier()
+            if tier is not None:
+                obj = tier.get(ref.digest)
+                if obj is not None:
+                    self._count("obj_cache_hits")
+                    return obj
         obj = self._objs.get(ref.digest)
         if obj is not None or ref.digest in self._objs:
             self._count("obj_cache_hits")
+            if device:
+                tier = self._device_tier()
+                if tier is not None:
+                    return tier.put(ref.digest, obj)
             return obj
         data = self.fetch_bytes(ref)
         # Store resolution is a host->device boundary: deserializing a
@@ -278,11 +298,24 @@ class StoreClient:
 
         with DEVICE.transfer("store_resolve", len(data)):
             obj = serialization.loads(data)
+        if device:
+            tier = self._device_tier()
+            if tier is not None:
+                # Replicate across the mesh now (accounted under the
+                # `ici` site) and cache the device-resident form — the
+                # host-bytes copy stays in LocalStore for re-promotion.
+                obj = tier.put(ref.digest, obj)
         self._objs[ref.digest] = obj
         self._obj_order.append(ref.digest)
         while len(self._obj_order) > self._obj_cap:
             self._objs.pop(self._obj_order.pop(0), None)
         return obj
+
+    @staticmethod
+    def _device_tier():
+        from fiber_tpu import store as storemod
+
+        return storemod.device_store_tier()
 
     def fetch_bytes(self, ref: ObjectRef) -> bytes:
         """Serialized payload for ``ref``: local tiers first, then the

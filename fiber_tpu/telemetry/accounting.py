@@ -90,6 +90,9 @@ FIELDS = (
     # device plane
     "device_transfer_bytes", "device_transfer_s",
     "compile_s", "flops", "device_s",
+    # subset of device_transfer_bytes that rode the mesh (device-tier
+    # placement + fan-out, site="ici") — the ICI-vs-wire blame split
+    "ici_bytes",
 )
 
 _FIELD_SET = frozenset(FIELDS)
@@ -443,6 +446,7 @@ _MASTER_FIELDS = frozenset((
 _WORKER_FIELDS = frozenset((
     "cpu_s", "tasks_executed", "store_fetch_bytes",
     "device_transfer_bytes", "device_transfer_s", "compile_s",
+    "ici_bytes",
 ))
 
 
@@ -523,6 +527,7 @@ def render_report(report: Dict[str, Any]) -> str:
         f"  device         transfer "
         f"{int(total.get('device_transfer_bytes', 0))}B"
         f"/{total.get('device_transfer_s', 0.0):.3f}s"
+        f"  (ici {int(total.get('ici_bytes', 0))}B)"
         f"  compile {total.get('compile_s', 0.0):.3f}s"
         f"  device_s {total.get('device_s', 0.0):.3f}"
         f"  flops {total.get('flops', 0.0):.3g}",

@@ -160,11 +160,19 @@ class DeviceTelemetry:
         _m_transfer_seconds.observe(seconds, site=site)
         _m_transfer_bytes.observe(float(nbytes), site=site)
         # Accounting plane: the transfer bills the map whose chunk is
-        # ambient (the worker's store_resolve path), else overhead.
+        # ambient (the worker's store_resolve path), else overhead. The
+        # `ici` site (device-tier placement/fan-out) bills its own field
+        # too, so Pool.cost()/explain split blame: bytes that rode the
+        # mesh vs bytes that crossed sockets.
         from fiber_tpu.telemetry.accounting import COSTS
 
-        COSTS.bill_ambient(device_transfer_bytes=nbytes,
-                           device_transfer_s=seconds)
+        if site == "ici":
+            COSTS.bill_ambient(device_transfer_bytes=nbytes,
+                               device_transfer_s=seconds,
+                               ici_bytes=nbytes)
+        else:
+            COSTS.bill_ambient(device_transfer_bytes=nbytes,
+                               device_transfer_s=seconds)
         if FLIGHT.enabled:
             FLIGHT.record("device", "transfer", site=site,
                           bytes=nbytes, s=round(seconds, 6))

@@ -204,11 +204,23 @@ def explain_trace(spans: Sequence[Dict[str, Any]],
                    if ev.get("plane") == "device"
                    and ev.get("kind") == "transfer"]
     xfer_source = "device.transfer events"
+    by_site: Dict[str, Dict[str, float]] = {}
+
+    def _site_add(site: str, secs: float, nbytes: int) -> None:
+        slot = by_site.setdefault(site, {"transfers": 0, "bytes": 0,
+                                         "s": 0.0})
+        slot["transfers"] += 1
+        slot["bytes"] += nbytes
+        slot["s"] += secs
+
     if xfer_events:
         budget["transfer"] = sum(float(ev.get("s", 0.0))
                                  for ev in xfer_events)
         xfer_bytes = sum(int(ev.get("bytes", 0)) for ev in xfer_events)
         xfer_count = len(xfer_events)
+        for ev in xfer_events:
+            _site_add(str(ev.get("site", "?")), float(ev.get("s", 0.0)),
+                      int(ev.get("bytes", 0)))
     else:
         xfer_spans = [sp for sp in mine
                       if sp.get("name") == "device.transfer"]
@@ -217,20 +229,35 @@ def explain_trace(spans: Sequence[Dict[str, Any]],
         xfer_bytes = sum(int(sp.get("bytes", 0)) for sp in xfer_spans)
         xfer_count = len(xfer_spans)
         xfer_source = "device.transfer spans"
+        for sp in xfer_spans:
+            _site_add(str(sp.get("site", "?")),
+                      float(sp.get("dur", 0.0)), int(sp.get("bytes", 0)))
+    # The ICI-vs-wire blame split (docs/objectstore.md "Device tier"):
+    # `ici` transfers are mesh fan-out (device-tier placement) — bytes
+    # that did NOT cross sockets; wire bytes come from the store's
+    # wire-fetch events below. A verdict can now say "this map moved
+    # 64MB, 60MB of it over ICI".
     evidence["transfer"] = {
         "transfers": xfer_count, "bytes": xfer_bytes,
         "source": xfer_source,
+        "by_site": {site: {"transfers": int(v["transfers"]),
+                           "bytes": int(v["bytes"]),
+                           "s": round(v["s"], 6)}
+                    for site, v in sorted(by_site.items())},
+        "ici_bytes": int(by_site.get("ici", {}).get("bytes", 0)),
     }
 
     wire_fetches = [ev for ev in scoped
                     if ev.get("plane") == "store"
                     and ev.get("kind") == "fetch" and ev.get("wire")]
+    wire_bytes = sum(int(ev.get("bytes", 0)) for ev in wire_fetches)
     budget["locality_miss"] = sum(float(ev.get("s", 0.0))
                                   for ev in wire_fetches)
     evidence["locality_miss"] = {
         "wire_fetches": len(wire_fetches),
-        "bytes": sum(int(ev.get("bytes", 0)) for ev in wire_fetches),
+        "bytes": wire_bytes,
     }
+    evidence["transfer"]["wire_bytes"] = wire_bytes
 
     budget["backpressure"] = sum(
         float(ev.get("wait_s", 0.0)) for ev in scoped
@@ -302,6 +329,12 @@ def render(verdict: Dict[str, Any]) -> str:
         lines.append(
             f"transfer evidence: {ev['transfers']} host->device "
             f"transfer(s), {ev['bytes']} bytes [{ev['source']}]")
+    if ev and (ev.get("ici_bytes") or ev.get("wire_bytes")):
+        # The data-plane split: bytes that rode the mesh vs bytes that
+        # crossed sockets (docs/objectstore.md "Device tier").
+        lines.append(
+            f"transfer split: ici {ev.get('ici_bytes', 0)}B over the "
+            f"mesh, wire {ev.get('wire_bytes', 0)}B over sockets")
     frames = verdict.get("evidence", {}).get("compute_frames")
     if frames and verdict.get("primary") == "compute":
         lines.append("compute is the verdict — top sampled frames:")

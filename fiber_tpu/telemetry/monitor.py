@@ -194,14 +194,22 @@ class AnomalyWatchdog:
             bytes=int(txq))
 
         # 6. HBM fill (device telemetry plane; both fields None on CPU
-        # or when no device runtime exists — honest null, no breach)
+        # or when no device runtime exists — honest null, no breach).
+        # This rule REMEDIATES, not just observes: on the breach edge
+        # the device store tier is demoted to the host tiers (its HBM
+        # is the one allocation the runtime can safely shed — the host
+        # store still holds every byte), and re-promoted on the clear
+        # edge. Closed loop, flight-evented by the tier itself.
         used, limit = _hbm_usage()
+        hbm_breached = limit > 0 and used > self.hbm_fill_pct * limit
+        hbm_was_active = "hbm_fill" in self._active
         self._edge(
-            "hbm_fill",
-            limit > 0 and used > self.hbm_fill_pct * limit,
+            "hbm_fill", hbm_breached,
             detail=(f"HBM {used >> 20}MB > "
                     f"{self.hbm_fill_pct:.0%} of {limit >> 20}MB"),
             bytes=used, limit=limit)
+        if hbm_breached != hbm_was_active:
+            _device_tier_remediate(demote=hbm_breached)
 
         # 7. recompile storm: one fingerprint compiling repeatedly
         # inside the device plane's window — shape churn, not progress
@@ -291,6 +299,26 @@ def _store_disk_usage() -> "tuple[int, int]":
         return 0, 0
 
 
+def _device_tier_remediate(demote: bool) -> None:
+    """The ``hbm_fill`` rule's remediation arm: demote the device store
+    tier on the breach edge, re-promote on the clear edge. Peek-only —
+    a host with no device tier has nothing to shed, and the watchdog
+    must never instantiate one (monkeypatchable in tests, like
+    ``_store_disk_usage``)."""
+    try:
+        from fiber_tpu import store as storemod
+
+        tier = storemod._dtier  # peek, never instantiate
+        if tier is None:
+            return
+        if demote:
+            tier.demote("hbm_fill")
+        else:
+            tier.promote()
+    except Exception:  # noqa: BLE001 - monitoring must not fail
+        logger.exception("monitor: device-tier remediation failed")
+
+
 #: Process-wide watchdog; registered as a TIMESERIES observer by
 #: telemetry.refresh().
 WATCHDOG = AnomalyWatchdog()
@@ -331,12 +359,24 @@ def _device_summary() -> Dict[str, Any]:
         from fiber_tpu.telemetry.device import DEVICE
 
         snap = DEVICE.snapshot()
-        return {
+        out = {
             "hbm_bytes_in_use": snap["hbm"].get("bytes_in_use"),
             "hbm_bytes_limit": snap["hbm"].get("bytes_limit"),
             "mfu": snap["mfu"].get("mfu"),
             "compiles": snap.get("compiles", 0),
             "transfer_bytes": snap.get("transfer_bytes", 0),
+            # device store tier occupancy (None = no tier built here —
+            # a host-plane process; 'top' renders it '-')
+            "dev_store_bytes": None,
+            "dev_store_demoted": None,
         }
+        from fiber_tpu import store as storemod
+
+        tier = storemod._dtier  # peek, never instantiate
+        if tier is not None:
+            tstats = tier.stats()
+            out["dev_store_bytes"] = int(tstats.get("bytes", 0))
+            out["dev_store_demoted"] = bool(tstats.get("demoted"))
+        return out
     except Exception:  # noqa: BLE001 - monitoring must not fail
         return {}

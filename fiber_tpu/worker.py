@@ -245,6 +245,18 @@ def main(argv=None) -> int:
         exitcode = process_obj._bootstrap()
     finally:
         _worker_done.set()
+        # Return device-tier HBM promptly: params this worker cached on
+        # the chips (store/device_tier.py) should not stay resident until
+        # interpreter teardown — the next worker on this host wants the
+        # headroom. Peek, never instantiate.
+        try:
+            from fiber_tpu import store as storemod
+
+            tier = storemod._dtier
+            if tier is not None:
+                tier.clear()
+        except Exception:  # noqa: BLE001 - best-effort cleanup on exit
+            pass
     try:
         conn.close()
     except OSError:

@@ -46,6 +46,11 @@ DIRECTIONS: Dict[str, str] = {
     # full-stack cluster bench
     "cluster_evals_per_sec": "higher",
     "cluster_bytes_per_task": "lower",
+    # device-tier data plane (bench-ici; null-mfu CPU runs record but
+    # contribute no numeric points to cluster_device_mfu)
+    "cluster_device_mfu": "higher",
+    "ici_repeat_wire_bytes": "lower",
+    "ici_broadcast_wall_ratio": "higher",
 }
 
 #: "special" metrics gate named RATIO FIELDS instead of "value"

@@ -432,3 +432,19 @@ class PoisonOnLoad:
 
     def __reduce__(self):
         return (_explode_on_load, ())
+
+
+def arr_sum_plus_accel(arr, i):
+    """arr_sum_plus with an accelerator hint: @meta(tpu=1) marks the
+    task device-destined, so its broadcast refs carry device_hint and
+    the worker resolves them through the device store tier
+    (docs/objectstore.md "Device tier")."""
+    return float(arr.sum()) + i
+
+
+# Decorated at import so master and worker agree on the meta; the
+# import stays below the function to keep targets importable before
+# fiber_tpu config exists in exotic child bootstraps.
+from fiber_tpu.meta import meta as _meta  # noqa: E402
+
+arr_sum_plus_accel = _meta(tpu=1)(arr_sum_plus_accel)

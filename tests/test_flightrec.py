@@ -307,6 +307,29 @@ def test_explain_blames_transfer_with_bytes_evidence():
     assert str((8 << 20) + (2 << 20)) in rendered
 
 
+def test_explain_splits_transfer_blame_ici_vs_wire():
+    """The data-plane blame split (docs/objectstore.md "Device tier"):
+    `ici`-site bytes rode the mesh, wire-fetch bytes crossed sockets —
+    the verdict carries both and the rendering names the split."""
+    events = [
+        {"ts": 0.05, "plane": "device", "kind": "transfer",
+         "site": "ici", "bytes": 64 << 20, "s": 0.4},
+        {"ts": 0.06, "plane": "device", "kind": "transfer",
+         "site": "store_resolve", "bytes": 8 << 20, "s": 0.1},
+        {"ts": 0.07, "plane": "store", "kind": "fetch",
+         "digest": "aa", "bytes": 8 << 20, "wire": True, "s": 0.2},
+    ]
+    verdict = explain.explain_trace(_spans(), events)
+    ev = verdict["evidence"]["transfer"]
+    assert ev["ici_bytes"] == 64 << 20
+    assert ev["wire_bytes"] == 8 << 20
+    assert ev["by_site"]["ici"]["bytes"] == 64 << 20
+    assert ev["by_site"]["ici"]["transfers"] == 1
+    rendered = explain.render(verdict)
+    assert f"ici {64 << 20}B" in rendered
+    assert f"wire {8 << 20}B" in rendered
+
+
 def test_explain_transfer_falls_back_to_spans():
     """Artifacts recorded without the flight recorder still classify:
     device.transfer spans are the fallback source."""

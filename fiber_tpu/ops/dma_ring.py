@@ -72,6 +72,26 @@ def ring_exchange(arrays: Sequence, *, axis: str, n_dev: int = None,
         ins, outs, sems = refs[:k], refs[k:2 * k], refs[2 * k:]
         my = jax.lax.axis_index(axis)
         right = jax.lax.rem(my + 1, n_dev)
+        if not interpret:
+            # Neighbor barrier BEFORE any remote write (the documented
+            # right-permute discipline): a remote DMA lands in the
+            # receiver's buffer whether or not it has entered the
+            # kernel yet, so without this handshake a fast sender can
+            # scribble into memory the neighbor's previous step is
+            # still using. Signal both neighbors, wait for both — the
+            # left one because it writes into US. Compiled-only:
+            # interpret mode has no remote-signal lowering (probed,
+            # jax 0.4.37) and no race either — its DMA discharge rule
+            # runs the per-device programs lockstep via all_gather.
+            left = jax.lax.rem(my + n_dev - 1, n_dev)
+            barrier = pltpu.get_barrier_semaphore()
+            pltpu.semaphore_signal(
+                barrier, inc=1, device_id=_device_id(left, interpret),
+                device_id_type=pltpu.DeviceIdType.MESH)
+            pltpu.semaphore_signal(
+                barrier, inc=1, device_id=_device_id(right, interpret),
+                device_id_type=pltpu.DeviceIdType.MESH)
+            pltpu.semaphore_wait(barrier, 2)
         copies = [
             pltpu.make_async_remote_copy(
                 src_ref=ins[i],
@@ -101,12 +121,20 @@ def ring_exchange(arrays: Sequence, *, axis: str, n_dev: int = None,
                    for _ in range(k)],
         scratch_shapes=[pltpu.SemaphoreType.DMA] * (2 * k),
     )
+    kwargs = {}
+    if not interpret:
+        # get_barrier_semaphore needs a collective_id so concurrent
+        # collective kernels never share one barrier; every ring
+        # rotation in a program runs sequentially, so one id is safe.
+        kwargs["compiler_params"] = pltpu.TPUCompilerParams(
+            collective_id=0)
     out = pl.pallas_call(
         kernel,
         out_shape=[jax.ShapeDtypeStruct(a.shape, a.dtype)
                    for a in arrays],
         grid_spec=grid_spec,
         interpret=interpret,
+        **kwargs,
     )(*arrays)
     return list(out)
 

@@ -1204,6 +1204,10 @@ class Pool:
         self._map_wall0: Dict[int, float] = {}
         self._job_records: Dict[Tuple[str, str, str], str] = {}
         self._map_budgets: Dict[Tuple[str, str, str], CostBudget] = {}
+        #: raw-content digest -> store-space digest for device-map
+        #: broadcast args (_device_broadcast_split): repeat generations
+        #: skip the serialize copy, paying one zero-copy hash.
+        self._bcast_digests: Dict[str, str] = {}
         if processes is None:
             processes = get_backend().default_pool_size()
         if processes < 1:
@@ -1829,9 +1833,13 @@ class Pool:
         keys on object identity so the classic broadcast pattern — the
         same params object in every item — is hashed and stored ONCE
         per map, not once per task. ``bkey`` bills each stored payload
-        to the submitting map (accounting plane); ``device_hint`` marks
-        the refs device-destined so resolving workers route them
-        through the shared device tier (one H2D per host per digest)."""
+        to the submitting map (accounting plane); ``device_hint`` makes
+        refs SHARED across items (the broadcast idiom, detected via the
+        memo) device-destined so resolving workers route them through
+        the shared device tier (one H2D per host per digest). Per-item
+        payloads never get the hint: mesh-replicating every distinct
+        item would cost n_dev x HBM per item and churn the tier's LRU
+        out of the actual broadcast params."""
         memo: Dict[int, Tuple[Any, Any]] = {}
         return [self._encode_item(it, memo, seq_digests, bkey,
                                   device_hint)
@@ -1853,7 +1861,16 @@ class Pool:
         key = id(obj)
         hit = memo.get(key)
         if hit is not None:
-            return hit[1]
+            enc = hit[1]
+            if device_hint and isinstance(enc, ObjectRef) \
+                    and not enc.device_hint:
+                # Second sighting of the same object: this ref is a
+                # broadcast shared across items, the only shape worth
+                # mesh replication. One shared instance rides every
+                # item, so flipping it here marks them all (chunks are
+                # serialized after encoding finishes).
+                enc.device_hint = True
+            return enc
         hint = _payload_size_hint(obj)
         if hint is not None and hint <= self._store_inline_max:
             return obj
@@ -1866,7 +1883,6 @@ class Pool:
             return obj
         ref = self._objstore.put_bytes(data, refs=1,
                                        owner=self._store_addr)
-        ref.device_hint = device_hint
         seq_digests.append(ref.digest)
         if bkey is not None:
             COSTS.charge(bkey, store_put_bytes=len(data))
@@ -2595,9 +2611,11 @@ class Pool:
                 if self._objstore is not None and self._store_inline_max:
                     seq_digests: List[str] = []
                     # Accelerator-destined maps (@meta tpu/gpu/device)
-                    # mark their refs so resolving workers route them
-                    # through the shared device tier — one H2D per host
-                    # per digest, not per worker.
+                    # mark their BROADCAST refs (shared across items —
+                    # the encoder's memo detects sharing) so resolving
+                    # workers route those through the shared device
+                    # tier — one H2D per host per digest, not per
+                    # worker. Per-item refs stay unhinted.
                     fmeta = get_meta(func)
                     dev_hint = bool(fmeta.get("tpu") or fmeta.get("gpu")
                                     or fmeta.get("device"))
@@ -2795,7 +2813,6 @@ class Pool:
         if not positions or len(positions) == width:
             return items, (), ()
         from fiber_tpu import store as storemod
-        from fiber_tpu.store.core import digest_of
 
         tier = storemod.device_store_tier()
         bcast = []
@@ -2805,8 +2822,7 @@ class Pool:
             if tier is None:
                 bcast.append(arr)
                 continue
-            head = f"{arr.dtype}|{arr.shape}|".encode()
-            dig = digest_of(head + np.ascontiguousarray(arr).tobytes())
+            dig = self._bcast_store_digest(arr)
             bcast.append(tier.put(dig, arr))
             digests.append(dig)
         if digests:
@@ -2821,6 +2837,35 @@ class Pool:
         stripped = [tuple(a for j, a in enumerate(it)
                           if j not in pos_set) for it in items]
         return stripped, tuple(bcast), tuple(positions)
+
+    def _bcast_store_digest(self, arr) -> str:
+        """STORE-space digest (digest_of over serialization.dumps —
+        the space ObjectRefs live in, so the locality seed matches
+        host-path refs of the identical payload; a raw dtype|shape|
+        bytes digest never intersects it) with a content-addressed
+        shortcut: the raw buffer is hashed zero-copy and mapped to the
+        serialized-form digest, so repeat generations of the ES
+        broadcast idiom skip the serialize copy. Sound under in-place
+        mutation — both sides of the cache are pure content
+        addresses."""
+        import hashlib
+
+        import numpy as np
+
+        from fiber_tpu.store.core import digest_of
+
+        buf = np.ascontiguousarray(arr)
+        h = hashlib.sha256()
+        h.update(f"{arr.dtype}|{arr.shape}|".encode())
+        h.update(memoryview(buf).cast("B"))
+        raw = h.hexdigest()
+        dig = self._bcast_digests.get(raw)
+        if dig is None:
+            dig = digest_of(serialization.dumps(arr))
+            self._bcast_digests[raw] = dig
+            while len(self._bcast_digests) > 32:
+                self._bcast_digests.pop(next(iter(self._bcast_digests)))
+        return dig
 
     def _dispatch_async(self, func, items, star, chunksize,
                         callback, error_callback, priority=1.0,

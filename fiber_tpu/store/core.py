@@ -69,12 +69,15 @@ class ObjectRef:
     address (``tcp://ip:port``) that is guaranteed to be able to serve
     it. Tiny and picklable — this is what rides task/result frames.
 
-    ``device_hint`` marks a device-destined payload (the map function's
-    @meta asks for an accelerator): the resolving worker routes it
+    ``device_hint`` marks a device-destined BROADCAST payload (the map
+    function's @meta asks for an accelerator and the encoder saw the
+    object shared across items): the resolving worker routes it
     through the store's DEVICE tier (docs/objectstore.md "Device
     tier"), so one host pays one H2D per digest no matter how many
-    co-located workers resolve it. A hint, never a requirement —
-    resolution without a tier is the ordinary host path."""
+    co-located workers resolve it. Per-item payloads never carry the
+    hint — mesh-replicating each would burn n_dev x HBM per item. A
+    hint, never a requirement — resolution without a tier is the
+    ordinary host path."""
 
     __slots__ = ("digest", "size", "owner", "device_hint")
 

@@ -165,7 +165,11 @@ class DeviceTier:
         mesh = self._mesh()
         n_dev = self._n_dev(mesh)
         leaves, treedef = jax.tree.flatten(obj)
-        nbytes = sum(int(getattr(np.asarray(leaf), "nbytes", 0))
+        # nbytes straight off the leaf — np.ndarray and jax.Array both
+        # expose it; np.asarray here would force a full D2H copy per
+        # leaf whenever put() is handed an already-device-resident
+        # pytree (the _objs-hit re-put after a demote/promote cycle).
+        nbytes = sum(int(getattr(leaf, "nbytes", 0))
                      for leaf in leaves
                      if isinstance(leaf, (np.ndarray, np.generic))
                      or hasattr(leaf, "__jax_array__")

@@ -271,7 +271,14 @@ class StoreClient:
         zero H2D; a miss fills the tier so the NEXT resolution (this
         process or a co-located pool on the same chips) is free. The
         tier is a no-op when disabled, demoted by the ``hbm_fill``
-        watchdog, or on a pure host plane."""
+        watchdog, or on a pure host plane.
+
+        ``_objs`` holds HOST forms only — the tier owns every device-
+        resident pytree. Caching the replicated form here would hand
+        jax device arrays to later device=False callers, and (worse)
+        pin the replicated HBM past an ``hbm_fill`` demotion: the
+        remediation would shed the tier while this cache quietly keeps
+        the bytes resident."""
         self._count("resolves")
         if device:
             tier = self._device_tier()
@@ -286,6 +293,8 @@ class StoreClient:
             if device:
                 tier = self._device_tier()
                 if tier is not None:
+                    # Replicate from the cached host form; a demoted
+                    # tier hands the host object straight back.
                     return tier.put(ref.digest, obj)
             return obj
         data = self.fetch_bytes(ref)
@@ -298,17 +307,18 @@ class StoreClient:
 
         with DEVICE.transfer("store_resolve", len(data)):
             obj = serialization.loads(data)
-        if device:
-            tier = self._device_tier()
-            if tier is not None:
-                # Replicate across the mesh now (accounted under the
-                # `ici` site) and cache the device-resident form — the
-                # host-bytes copy stays in LocalStore for re-promotion.
-                obj = tier.put(ref.digest, obj)
         self._objs[ref.digest] = obj
         self._obj_order.append(ref.digest)
         while len(self._obj_order) > self._obj_cap:
             self._objs.pop(self._obj_order.pop(0), None)
+        if device:
+            tier = self._device_tier()
+            if tier is not None:
+                # Replicate across the mesh now (accounted under the
+                # `ici` site) and hand the device form ONLY to this
+                # device-destined caller; the host copy above is what
+                # re-promotion (and host-plane callers) resolve from.
+                return tier.put(ref.digest, obj)
         return obj
 
     @staticmethod

@@ -6,10 +6,15 @@
 payload) — the BENCH_*.json records overwrite in place, so without the
 history the perf trajectory across commits is invisible. This script
 reads the history, and for every metric whose direction is known,
-compares the LATEST recorded value against the BEST ever recorded:
-a latest value more than ``--tolerance`` (default 10%) worse than the
-best is a regression and the script exits 1, printing one line per
-finding.
+compares the LATEST recorded value against the MEDIAN of all prior
+records AND against the most recent prior record: only a value more
+than ``--tolerance`` (default 10%) worse than BOTH is a regression
+(exit 1, one line per finding). The dual reference separates code
+regressions from box weather: a code regression lands as a step
+change at this commit (worse than the previous record AND the
+trajectory), while host drift moves adjacent records together and a
+single lucky record (a cold box slowing the baseline arm of a ratio
+bench) would otherwise ratchet a best-ever bar permanently.
 
 Unknown metrics are listed but never gated (a new bench arm must not
 fail CI until its direction is declared here).
@@ -19,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import statistics
 import sys
 from typing import Dict, List, Tuple
 
@@ -119,21 +125,24 @@ def check(path: str, tolerance: float) -> int:
         checked += 1
         values = [v for _, v in series]
         latest_entry, latest = series[-1]
-        best = max(values[:-1]) if direction == "higher" \
-            else min(values[:-1])
+        ref = statistics.median(values[:-1])
+        prev = values[-2]
         if direction == "higher":
-            regressed = latest < best * (1.0 - tolerance)
+            regressed = (latest < ref * (1.0 - tolerance)
+                         and latest < prev * (1.0 - tolerance))
         else:
-            regressed = latest > best * (1.0 + tolerance)
+            regressed = (latest > ref * (1.0 + tolerance)
+                         and latest > prev * (1.0 + tolerance))
         label = f"{metric}.{field}" if field != "value" else metric
         if regressed:
             regressions += 1
             print(f"REGRESSION {label}: latest {latest:g} "
-                  f"(sha {latest_entry.get('sha') or '?'}) vs best "
-                  f"{best:g} — worse by more than {tolerance:.0%}")
+                  f"(sha {latest_entry.get('sha') or '?'}) vs median "
+                  f"{ref:g} / prev {prev:g} — worse by more than "
+                  f"{tolerance:.0%}")
         else:
-            print(f"ok  {label}: latest {latest:g}  best {best:g}  "
-                  f"({len(series)} recorded)")
+            print(f"ok  {label}: latest {latest:g}  median {ref:g}  "
+                  f"prev {prev:g}  ({len(series)} recorded)")
     for e in entries:
         m = e.get("metric")
         if m and m not in DIRECTIONS:
@@ -153,8 +162,8 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="bench_check")
     parser.add_argument("--history", default="BENCH_history.jsonl")
     parser.add_argument("--tolerance", type=float, default=0.10,
-                        help="allowed fractional slack vs the best "
-                             "recorded value (default 10%%)")
+                        help="allowed fractional slack vs the median "
+                             "of prior records (default 10%%)")
     args = parser.parse_args(argv)
     return check(args.history, max(0.0, float(args.tolerance)))
 

@@ -597,9 +597,12 @@ def test_log_ring_tail_in_postmortem_and_explain(tmp_path, capsys):
 
 
 def test_bench_check_flags_gated_regressions(tmp_path, capsys):
-    """scripts/bench_check.py: a latest gated value >10% worse than the
-    best recorded fails; within tolerance passes; unknown metrics are
-    listed, never gated."""
+    """scripts/bench_check.py: a latest gated value >10% worse than
+    BOTH the median of prior records and the most recent prior fails
+    (a step change at this commit); within tolerance of either passes
+    (box drift moves adjacent records together); unknown metrics are
+    listed, never gated; a single outlier-good record does not ratchet
+    the bar."""
     import importlib.util
 
     spec = importlib.util.spec_from_file_location(
@@ -628,6 +631,37 @@ def test_bench_check_flags_gated_regressions(tmp_path, capsys):
     assert "REGRESSION cluster_evals_per_sec" in out
     assert "some_new_metric" in out  # listed as unknown, not gated
     write(1.05, 139.0)   # within tolerance
+    assert mod.check(str(hist), 0.10) == 0
+
+    # Median reference: one lucky record (box-weather outlier) must not
+    # ratchet the bar — best-ever 1.9 would flag 1.55, median 1.66
+    # keeps it green.
+    lines = [
+        {"metric": "ici_broadcast_wall_ratio", "value": 1.9},
+        {"metric": "ici_broadcast_wall_ratio", "value": 1.66},
+        {"metric": "ici_broadcast_wall_ratio", "value": 1.66},
+        {"metric": "ici_broadcast_wall_ratio", "value": 1.55},
+    ]
+    hist.write_text("\n".join(json.dumps(ln) for ln in lines))
+    assert mod.check(str(hist), 0.10) == 0
+    out = capsys.readouterr().out
+    assert "median 1.66" in out
+    # ...but a genuine collapse (a step below BOTH the median and the
+    # previous record) still fails.
+    lines[-1] = {"metric": "ici_broadcast_wall_ratio", "value": 1.2}
+    hist.write_text("\n".join(json.dumps(ln) for ln in lines))
+    assert mod.check(str(hist), 0.10) == 1
+    capsys.readouterr()
+    # Gradual box drift: the latest record is >10% under the median but
+    # within tolerance of the record just before it — adjacent records
+    # moved together, so no step change is attributed to this commit.
+    lines = [
+        {"metric": "cluster_evals_per_sec", "value": 220.0},
+        {"metric": "cluster_evals_per_sec", "value": 217.0},
+        {"metric": "cluster_evals_per_sec", "value": 182.0},
+        {"metric": "cluster_evals_per_sec", "value": 179.0},
+    ]
+    hist.write_text("\n".join(json.dumps(ln) for ln in lines))
     assert mod.check(str(hist), 0.10) == 0
 
 

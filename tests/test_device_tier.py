@@ -280,6 +280,52 @@ def test_resolve_device_shares_one_replication_per_host():
         server.close()
 
 
+def test_resolve_host_cache_never_holds_device_forms():
+    """Regression (review r12 #1): the client's host object cache must
+    keep the HOST form — a device=True resolution hands out the tier's
+    replicated pytree, but a later device=False resolve of the same
+    digest returns host arrays, and after an hbm_fill demotion nothing
+    outside the tier pins the replicated jax.Arrays (the demote would
+    otherwise never free the HBM it exists to shed)."""
+    import jax
+
+    from fiber_tpu import serialization
+    from fiber_tpu.store import LocalStore
+    from fiber_tpu.store.plane import StoreClient, StoreServer
+
+    arr = _mb(1, 37)
+    st = LocalStore(capacity_bytes=64 << 20)
+    server = StoreServer(st, "127.0.0.1")
+    try:
+        ref = st.put_bytes(serialization.dumps(arr))
+        wire_ref = type(ref)(ref.digest, ref.size, server.addr, True)
+        client = StoreClient(LocalStore(capacity_bytes=64 << 20))
+        dev = client.resolve(wire_ref, device=True)
+        assert isinstance(dev, jax.Array)
+        # Host-plane caller of the same digest: host array, not the
+        # device form the tier cached.
+        host = client.resolve(wire_ref, device=False)
+        assert isinstance(host, np.ndarray)
+        np.testing.assert_array_equal(host, arr)
+        # The obj cache itself holds no device arrays to pin HBM past
+        # a demotion.
+        assert all(not isinstance(v, jax.Array)
+                   for v in client._objs.values())
+        tier = storemod.device_store_tier()
+        tier.demote()
+        try:
+            # Demoted: both planes degrade to the host form, zero wire.
+            served = server.stats()["bytes_served"]
+            out = client.resolve(wire_ref, device=True)
+            assert isinstance(out, np.ndarray)
+            assert server.stats()["bytes_served"] == served
+        finally:
+            tier.promote()
+        client.close()
+    finally:
+        server.close()
+
+
 def test_objectref_device_hint_pickles_and_defaults():
     from fiber_tpu.store.core import ObjectRef
 
@@ -288,6 +334,26 @@ def test_objectref_device_hint_pickles_and_defaults():
     legacy = ObjectRef("d" * 8, 128, "1.2.3.4:1")
     assert legacy.device_hint is False
     assert pickle.loads(pickle.dumps(legacy)).device_hint is False
+
+
+def test_device_hint_marks_only_shared_broadcast_refs():
+    """Regression (review r12 #2): on a device-destined map only refs
+    SHARED across items (the broadcast idiom) carry device_hint —
+    per-item payloads must not be mesh-replicated n_dev-wide or churn
+    the tier's LRU out of the actual broadcast params."""
+    from fiber_tpu.store.core import ObjectRef
+
+    shared = _mb(1, 41)
+    uniq = [_mb(1, 42 + i) for i in range(3)]
+    with fiber_tpu.Pool(2) as pool:
+        digs = []
+        enc = pool._encode_items([(shared, u) for u in uniq], digs,
+                                 None, device_hint=True)
+    assert all(isinstance(e, ObjectRef) for it in enc for e in it)
+    shared_refs = {it[0] for it in enc}
+    assert len(shared_refs) == 1  # memo: one ref instance for all items
+    assert next(iter(shared_refs)).device_hint is True
+    assert all(it[1].device_hint is False for it in enc)
 
 
 def test_chaos_store_fetch_fails_through_device_path(tmp_path):

@@ -5,8 +5,8 @@ PYTEST ?= python -m pytest tests/ -q
 
 .PHONY: test stest test-all lint bench bench-store bench-telemetry \
 	bench-sched bench-transport bench-cluster bench-recovery \
-	bench-accounting bench-check bench-scale bench-ici weakscale \
-	docs chaos
+	bench-accounting bench-check bench-scale bench-ici \
+	bench-autonomy weakscale docs chaos
 
 # Tier 1: local backend (subprocess jobs)
 test:
@@ -36,6 +36,9 @@ chaos:
 	FIBER_CHAOS_SEED=202 python -m pytest tests/test_chaos.py -q
 	FIBER_CHAOS_SEED=303 python -m pytest tests/test_chaos.py -q
 	FIBER_CHAOS_SEED=404 FIBER_TRANSPORT_IO=shm \
+		python -m pytest tests/test_chaos.py -q
+	FIBER_CHAOS_SEED=505 FIBER_POLICY_VERIFY_S=0.2 \
+		FIBER_POLICY_COOLDOWN_S=0 \
 		python -m pytest tests/test_chaos.py -q
 
 # FIBER_BENCH_ENFORCE: fail loudly when the 1 ms host-pool point
@@ -72,6 +75,16 @@ bench-telemetry:
 bench-accounting:
 	JAX_PLATFORMS=cpu python bench.py --accounting --record > BENCH_accounting.json; \
 	rc=$$?; cat BENCH_accounting.json; exit $$rc
+
+# Policy-plane (autonomous operations) gate (docs/observability.md
+# "Autonomous operations"): per-fault-class anomaly -> action ->
+# outcome chain drills (every class must leave a complete
+# cause_id-linked flight chain), a policy-enabled chaos soak that must
+# lose zero tasks, and the engine's on-but-idle pool overhead (must
+# stay <= 5%). The record lands in BENCH_autonomy.json either way.
+bench-autonomy:
+	JAX_PLATFORMS=cpu python bench.py --autonomy --record > BENCH_autonomy.json; \
+	rc=$$?; cat BENCH_autonomy.json; exit $$rc
 
 # Bench-trajectory regression check: compares the latest recorded value
 # of every gated metric in BENCH_history.jsonl (written by --record)

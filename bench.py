@@ -484,6 +484,255 @@ def _sched_bench(args) -> int:
     return 1 if (over or slow) else 0
 
 
+#: `make bench-autonomy` gates (docs/observability.md "Autonomous
+#: operations"): every injected fault class must yield a COMPLETE
+#: narrated flight chain (anomaly -> cause_id-linked action -> verified
+#: outcome), the policy-enabled chaos soak must lose zero tasks, and
+#: the engine on-but-idle may cost <= 5% on the signature small-task
+#: workload (it rides hooks that already fired; idle it must be free).
+_AUTONOMY_BUDGET = 1.05
+
+
+def _autonomy_bench(args) -> int:
+    """Policy-plane (autonomous operations) bench, three phases:
+
+    1. **chain drills** — one synthetic breach per fault class
+       (tx_queue_high, heartbeat_age, store_disk_fill,
+       recompile_storm, budget_exceeded) against a fresh watchdog with
+       the engine live; each must leave a complete flight chain — the
+       anomaly event, at least one policy action linked by ``cause_id``,
+       and a verified outcome event.
+    2. **chaos soak** — the signature echo map under slow-worker +
+       worker-kill chaos with the policy engine ENABLED: every result
+       must come back exactly once (the engine throttling/boosting
+       mid-map must never lose a task).
+    3. **on-but-idle overhead** — small-task pool throughput with the
+       full monitor plane on, engine off vs on (no anomalies firing):
+       the engine may cost <= 5%.
+
+    Emits one JSON line per measurement plus a gate summary; exits
+    nonzero when any gate fails."""
+    import tempfile
+
+    os.environ["FIBER_BACKEND"] = "local"
+    import fiber_tpu
+    from fiber_tpu import config
+    from fiber_tpu.telemetry import explain as explainmod
+    from fiber_tpu.telemetry import monitor as monitormod
+    from fiber_tpu.telemetry import policy as policymod
+    from fiber_tpu.telemetry.flightrec import FLIGHT
+    from fiber_tpu.telemetry.monitor import AnomalyWatchdog, WATCHDOG
+    from fiber_tpu.telemetry.policy import POLICY
+    from fiber_tpu.telemetry.timeseries import TIMESERIES
+    from fiber_tpu.testing import chaos as chaosmod
+    from tests import targets
+
+    def _reset():
+        TIMESERIES.clear()
+        WATCHDOG.clear()
+        FLIGHT.clear()
+        POLICY.reset()
+
+    def _dog(**overrides) -> AnomalyWatchdog:
+        fiber_tpu.init(policy_verify_s=0.1, policy_cooldown_s=0.0,
+                       **overrides)
+        dog = AnomalyWatchdog()
+        dog.configure(config.get())
+        return dog
+
+    def _sample(**kw):
+        base = {"wall": time.time(), "mono": time.monotonic(),
+                "tasks_per_s": 0.0, "inflight": 0.0,
+                "queue_depth": 0.0, "heartbeat_age_s": 0.0,
+                "tx_queue_bytes": 0.0}
+        base.update(kw)
+        return base
+
+    # -- phase 1: per-fault-class chain drills -------------------------
+    def drill_tx(dog):
+        dog.observe(_sample(tx_queue_bytes=float(64 << 20)))
+        return None
+
+    def drill_heartbeat(dog):
+        from fiber_tpu.sched.core import Scheduler
+        from fiber_tpu.store.replicate import REPLICATOR
+
+        sched = Scheduler(n_workers=2, policy="adaptive",
+                          speculation=True, speculation_quantile=4.0)
+        REPLICATOR.register_driver(lambda reason: 1)
+        REPLICATOR.note(["d" * 64])
+        dog.observe(_sample(heartbeat_age_s=9.0))
+
+        def cleanup():
+            REPLICATOR.register_driver(None)
+            REPLICATOR.forget(["d" * 64])
+            sched.close()
+        return cleanup
+
+    def drill_store(dog):
+        from fiber_tpu import store as storemod
+        from fiber_tpu.store.core import LocalStore
+
+        st = LocalStore(
+            capacity_bytes=1 << 20,
+            root=tempfile.mkdtemp(prefix="fiber-bench-autonomy-"),
+            max_disk_bytes=100 << 10)
+        prev = storemod._store
+        storemod._store = st
+        for i in range(12):
+            st.put_bytes(bytes([i]) * (8 << 10), persist=True)
+        dog.observe(_sample())
+
+        def cleanup():
+            storemod._store = prev
+        return cleanup
+
+    def drill_recompile(dog):
+        storm = {"storm": True, "fingerprint": "bench.fn@" + "x" * 60,
+                 "count": 9, "window_s": 30}
+        prev = monitormod._recompile_state
+        monitormod._recompile_state = lambda: dict(storm)
+        dog.observe(_sample())
+
+        def cleanup():
+            monitormod._recompile_state = prev
+        return cleanup
+
+    def drill_budget(dog):
+        class _Billed:
+            def throttle_billing_key(self, key, factor=4.0):
+                return 1
+
+            def unthrottle_billing_key(self, key):
+                return 1
+
+        pool = _Billed()
+        policymod.register_pool(pool)
+        dog.external_breach("budget_exceeded",
+                            detail="tenant over budget",
+                            key="tenant/job/m1", observed=2.0)
+        return lambda p=pool: None  # closure keeps the stub referenced
+
+    drills = (
+        ("tx_queue_high", {}, drill_tx),
+        ("heartbeat_age", {"suspect_timeout": 10.0}, drill_heartbeat),
+        ("store_disk_fill", {}, drill_store),
+        ("recompile_storm", {}, drill_recompile),
+        ("budget_exceeded", {}, drill_budget),
+    )
+    chain_fail = []
+    for rule, overrides, drill in drills:
+        _reset()
+        dog = _dog(**overrides)
+        cleanup = drill(dog)
+        try:
+            POLICY.poll(now=time.monotonic() + 60.0)  # force the verify
+            chains = explainmod.policy_chains(FLIGHT.snapshot())
+            chain = next(
+                (c for c in chains if c["anomaly"] is not None
+                 and c["anomaly"].get("kind") == rule), None)
+            linked = (
+                chain is not None and len(chain["actions"]) >= 1
+                and len(chain["outcomes"]) >= 1
+                and all(e.get("cause_id") == chain["cause_id"]
+                        for e in chain["actions"] + chain["outcomes"]))
+            _emit({"metric": f"autonomy_chain_{rule}",
+                   "value": int(bool(linked)), "unit": "linked",
+                   "action": (chain["actions"][0].get("kind")
+                              if chain and chain["actions"] else None),
+                   "applied": (bool(chain["actions"][0].get("applied"))
+                               if chain and chain["actions"] else False),
+                   "outcome": (chain["outcomes"][0].get("outcome")
+                               if chain and chain["outcomes"] else None)})
+            if not linked:
+                chain_fail.append(rule)
+        finally:
+            if cleanup is not None:
+                cleanup()
+    _reset()
+
+    # -- phase 2: chaos soak with the engine live ----------------------
+    fiber_tpu.init(worker_lite=True, telemetry_enabled=True,
+                   trace_sample_rate=0.0, flightrec_enabled=True,
+                   monitor_enabled=True, monitor_interval_s=0.25,
+                   policy_enabled=True, policy_verify_s=0.5,
+                   policy_cooldown_s=0.0, speculation_enabled=True,
+                   speculation_quantile=2.0)
+    soak_tasks, workers = 120, 4
+    plan = chaosmod.install(chaosmod.ChaosPlan(
+        seed=13, token_dir=tempfile.mkdtemp(prefix="fiber-bench-autonomy-"),
+        slow_worker_after_chunks=1, slow_worker_s=0.4,
+        slow_worker_times=1, kill_after_chunks=2, kill_times=1))
+    try:
+        with fiber_tpu.Pool(workers) as pool:
+            pool.map(_timed_task, [0.0] * workers)  # spin-up barrier
+            t0 = time.perf_counter()
+            out = pool.map(targets.sleep_echo, list(range(soak_tasks)),
+                           chunksize=2)
+            soak_wall = time.perf_counter() - t0
+    finally:
+        chaosmod.uninstall()
+    lost = sum(1 for i, v in enumerate(out) if v != i) \
+        + max(0, soak_tasks - len(out))
+    _emit({"metric": "autonomy_soak_lost_tasks",
+           "value": lost, "unit": "tasks",
+           "tasks": soak_tasks, "wall_s": round(soak_wall, 3),
+           "worker_killed": plan.spent("kill"),
+           "slow_worker_claimed": plan.spent("slow"),
+           "policy_actions": int(POLICY.actions_total)})
+    _reset()
+
+    # -- phase 3: on-but-idle overhead ---------------------------------
+    n_tasks, duration = 600, 0.001
+    walls = {}
+    for mode, on in (("off", False), ("on", True)):
+        fiber_tpu.init(worker_lite=True, telemetry_enabled=True,
+                       trace_sample_rate=0.0, flightrec_enabled=True,
+                       monitor_enabled=True, monitor_interval_s=0.25,
+                       device_telemetry_enabled=False,
+                       accounting_enabled=False, policy_enabled=on)
+        best = None
+        for _ in range(int(args.autonomy_reps)):
+            with fiber_tpu.Pool(workers) as pool:
+                pool.map(_timed_task, [0.0] * workers)
+                t0 = time.perf_counter()
+                pool.map(_timed_task, [duration] * n_tasks)
+                wall = time.perf_counter() - t0
+            best = wall if best is None else min(best, wall)
+        walls[mode] = best
+        _emit({"metric": f"pool_policy_{mode}_tasks_per_sec",
+               "value": round(n_tasks / best, 1), "unit": "tasks/s",
+               "tasks": n_tasks, "task_s": duration,
+               "wall_s": round(best, 4)})
+    fiber_tpu.init()
+    overhead = round(walls["on"] / walls["off"], 4)
+
+    # -- gates ---------------------------------------------------------
+    over = overhead > _AUTONOMY_BUDGET
+    lossy = lost > 0
+    broken = bool(chain_fail)
+    _emit({"metric": "autonomy_gates",
+           "chains_linked": len(drills) - len(chain_fail),
+           "chains_total": len(drills),
+           "chains_broken": chain_fail,
+           "soak_lost_tasks": lost,
+           "idle_overhead": overhead,
+           "overhead_budget": _AUTONOMY_BUDGET,
+           "over_budget": bool(over), "lossy": bool(lossy),
+           "chain_fail": broken})
+    if broken:
+        print(f"FAIL: fault class(es) {chain_fail} left no complete "
+              "anomaly -> action -> outcome flight chain",
+              file=sys.stderr)
+    if lossy:
+        print(f"FAIL: policy-enabled chaos soak lost {lost} of "
+              f"{soak_tasks} tasks", file=sys.stderr)
+    if over:
+        print(f"FAIL: policy-engine idle overhead {overhead} exceeds "
+              f"budget {_AUTONOMY_BUDGET}", file=sys.stderr)
+    return 1 if (broken or lossy or over) else 0
+
+
 #: `make bench-recovery` gates (docs/robustness.md "Durable maps"): the
 #: write-ahead ledger must cost <= 5% on the NO-CRASH path (the common
 #: case pays for the rare one, bounded), and resuming a 75%-journaled
@@ -1519,6 +1768,19 @@ def main() -> int:
                              "JAX_PLATFORMS=cpu)")
     parser.add_argument("--sched-reps", type=int, default=3,
                         help="walls per scenario for --sched (best-of)")
+    parser.add_argument("--autonomy", action="store_true",
+                        help="bench the policy plane instead "
+                             "(docs/observability.md 'Autonomous "
+                             "operations'): per-fault-class anomaly -> "
+                             "action -> outcome chain drills, a "
+                             "policy-enabled chaos soak (zero lost "
+                             "tasks), and the engine's on-but-idle "
+                             "pool overhead; fails past 5%% overhead, "
+                             "any lost task, or any unlinked chain. "
+                             "Pure host plane (runs on "
+                             "JAX_PLATFORMS=cpu)")
+    parser.add_argument("--autonomy-reps", type=int, default=3,
+                        help="walls per mode for --autonomy (best-of)")
     parser.add_argument("--transport", action="store_true",
                         help="bench the transport I/O core instead "
                              "(docs/transport.md): selector event loop "
@@ -1618,11 +1880,12 @@ def main() -> int:
     if sum((args.poet, args.pixels, args.biped, args.attention,
             args.lm, args.store, args.telemetry, args.sched,
             args.transport, args.cluster, args.recovery,
-            args.accounting, args.scale, args.ici)) > 1:
+            args.accounting, args.scale, args.ici,
+            args.autonomy)) > 1:
         parser.error("--poet/--pixels/--biped/--attention/--lm/--store/"
                      "--telemetry/--sched/--transport/--cluster/"
-                     "--recovery/--accounting/--scale/--ici are "
-                     "mutually exclusive")
+                     "--recovery/--accounting/--scale/--ici/--autonomy "
+                     "are mutually exclusive")
     if args.record:
         _arm_record()
     if args.store:
@@ -1637,6 +1900,8 @@ def main() -> int:
         return _telemetry_bench(args, only=("off", "accounting"))
     if args.sched:
         return _sched_bench(args)  # host-plane only, like --store
+    if args.autonomy:
+        return _autonomy_bench(args)  # host-plane only, like --store
     if args.transport:
         return _transport_bench(args)  # host-plane only, like --store
     if args.cluster:

@@ -26,6 +26,7 @@ import subprocess
 import sys
 import threading
 import time
+import weakref
 from multiprocessing.connection import Client
 from typing import Dict, List, Optional, Tuple
 
@@ -168,6 +169,21 @@ class TpuBackend(Backend):
                 self._probe_hosts, float(cfg.heartbeat_interval),
                 name="fiber-agent-prober",
             ).start()
+        # Policy-plane replication driver (telemetry/policy.py
+        # replicate_and_boost): lets a heartbeat_age / throughput_drop
+        # anomaly pre-emptively copy precious digests BEFORE the
+        # failure detector declares anyone suspect. Weakref so a
+        # registered driver never pins a dead backend alive.
+        from fiber_tpu.store.replicate import REPLICATOR
+
+        wself = weakref.ref(self)
+
+        def _drive(reason: str) -> int:
+            b = wself()
+            return (b._replicate_precious(reason=reason)
+                    if b is not None else 0)
+
+        REPLICATOR.register_driver(_drive)
         logger.info("tpu backend: %d host(s): %s", len(self._hosts),
                     self._hosts)
 
@@ -256,15 +272,23 @@ class TpuBackend(Backend):
         logger.info("health: host %s:%s revived; spawn breaker cleared",
                     host[0], host[1])
 
-    def _replicate_precious(self, suspect) -> int:
+    def _replicate_precious(self, suspect=None,
+                            reason: str = "suspect") -> int:
+        """Copy precious digests to healthy hosts. Two triggers share
+        this routine: a declared-suspect host (``suspect`` excluded
+        from targets) and the policy plane's pre-emptive drive on a
+        heartbeat_age / throughput_drop anomaly (no suspect yet —
+        every healthy host is a target)."""
         from fiber_tpu import store as storemod
         from fiber_tpu.store.replicate import REPLICATOR
 
         targets = [h for h in self._hosts
                    if h != suspect and self._host_healthy(h)]
         local = storemod.local_store()
+        key = (f"{suspect[0]}:{suspect[1]}" if suspect is not None
+               else str(reason))
         return REPLICATOR.replicate_for_suspect(
-            f"{suspect[0]}:{suspect[1]}", targets,
+            key, targets,
             get_bytes=local.get_bytes,
             host_has=lambda h, d: self._agent(h).call("store_has", d),
             host_put=lambda h, d, data: self._agent(h).call(

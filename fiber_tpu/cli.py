@@ -878,6 +878,27 @@ def cmd_top(args) -> int:
                         "%H:%M:%S", time.localtime(rec.get("wall", 0)))
                     print(f"  [{stamp}] {rec['host']} "
                           f"{rec.get('rule')}: {rec.get('detail')}")
+                # Recent policy actions (autonomous operations), same
+                # merge order; the glyph is the verified outcome:
+                # + resolved, ~ persisted, ! worsened, ? pending.
+                acted = []
+                for key, pull in pulls.items():
+                    if not isinstance(pull, dict):
+                        continue
+                    for act in pull.get("policy") or []:
+                        act = dict(act)
+                        act.setdefault("ts", act.get("wall", 0.0))
+                        act["host"] = key
+                        acted.append(act)
+                glyphs = {"resolved": "+", "persisted": "~",
+                          "worsened": "!"}
+                for act in order_events(acted)[-args.last:]:
+                    stamp = time.strftime(
+                        "%H:%M:%S", time.localtime(act.get("wall", 0)))
+                    g = glyphs.get(act.get("outcome"), "?")
+                    print(f"  [{stamp}] {act['host']} policy "
+                          f"{act.get('action')} <- {act.get('rule')} "
+                          f"[{g}]")
                 if args.costs:
                     print("costs (per billing key, top by cpu_s):")
                     for row in _render_cost_rows(costs, args.last):
@@ -1105,16 +1126,125 @@ def cmd_explain(args) -> int:
             quantile=args.quantile, profile=profile)
     except ValueError as err:
         raise SystemExit(f"error: {err}") from None
+    # Autonomous-operations narration (docs/observability.md): with a
+    # flight artifact, the anomaly -> action -> outcome chains the
+    # policy plane recorded ride beside the blame budget.
+    chains = explainmod.policy_chains(events) if events else []
     if args.json:
         if log_tail:
             verdict = dict(verdict, log_tail=log_tail)
+        if events:
+            verdict = dict(verdict, policy_chains=chains)
         print(json.dumps(verdict))
     else:
         print(explainmod.render(verdict))
+        if chains:
+            print(explainmod.render_chains(chains))
         if log_tail:
             print("recent log tail (flight artifact):")
             for line in log_tail:
                 print(f"  {line}")
+    return 0
+
+
+def cmd_policies(args) -> int:
+    """``fiber-tpu policies``: the autonomous-operations surface
+    (docs/observability.md "Autonomous operations"). Default: this
+    process's policy table + recent actions. ``--hosts`` pulls each
+    agent's recent actions instead; ``--flight`` narrates the
+    anomaly -> action -> outcome chains of a recorded artifact."""
+    from fiber_tpu.telemetry import explain as explainmod
+    from fiber_tpu.telemetry.policy import POLICY
+
+    glyphs = {"resolved": "+", "persisted": "~", "worsened": "!"}
+
+    def action_line(act: dict, host: str = "") -> str:
+        stamp = time.strftime("%H:%M:%S",
+                              time.localtime(act.get("wall", 0)))
+        g = glyphs.get(act.get("outcome"), "?")
+        mode = ("dry-run" if act.get("dry_run")
+                else ("applied" if act.get("applied") else "no-op"))
+        where = f"{host} " if host else ""
+        return (f"  [{stamp}] {where}{act.get('rule')} -> "
+                f"{act.get('action')} ({mode}) [{g}] "
+                f"{act.get('detail', '')}")
+
+    if getattr(args, "flight", ""):
+        try:
+            events = explainmod.load_events(args.flight)
+        except (OSError, ValueError) as err:
+            raise SystemExit(
+                f"error: cannot load flight events: {err}") from None
+        chains = explainmod.policy_chains(events)
+        if args.json:
+            print(json.dumps({"policy_chains": chains}, default=str))
+        else:
+            print(explainmod.render_chains(chains))
+        return 0
+
+    if args.hosts or getattr(args, "tpu", ""):
+        from fiber_tpu.backends.tpu import AgentClient
+        from fiber_tpu.telemetry.flightrec import order_events
+
+        rc = 0
+        pulls = {}
+        for host, port in _resolve_cli_hosts(args):
+            key = f"{host}:{port}"
+            client = AgentClient(host, port)
+            try:
+                pulls[key] = client.call("monitor_snapshot", 1)
+            except Exception as err:  # noqa: BLE001
+                pulls[key] = {"error": repr(err)}
+                rc = 1
+            finally:
+                client.close()
+        if args.json:
+            print(json.dumps(
+                {k: (p.get("policy") if isinstance(p, dict) else p)
+                 for k, p in pulls.items()}, default=str))
+            return rc
+        acted = []
+        for key, pull in pulls.items():
+            if not isinstance(pull, dict) or "error" in pull:
+                print(f"{key}  DOWN  "
+                      f"({(pull or {}).get('error', 'no payload')})")
+                continue
+            for act in pull.get("policy") or []:
+                act = dict(act)
+                act.setdefault("ts", act.get("wall", 0.0))
+                act["host"] = key
+                acted.append(act)
+        print(f"recent policy actions across {len(pulls)} host(s) "
+              "(+ resolved, ~ persisted, ! worsened, ? pending):")
+        ordered = order_events(acted)[-args.last:]
+        if not ordered:
+            print("  (none)")
+        for act in ordered:
+            print(action_line(act, host=act["host"]))
+        return rc
+
+    snap = POLICY.snapshot()
+    if args.json:
+        print(json.dumps(snap, default=str))
+        return 0
+    state = "enabled" if snap["enabled"] else "disabled"
+    if snap["enabled"] and snap["dry_run"]:
+        state += " (dry-run)"
+    print(f"policy engine: {state}  cooldown={snap['cooldown_s']:g}s  "
+          f"verify={snap['verify_s']:g}s  rules={snap['rules']}")
+    print(f"{'RULE':<18} {'ACTION':<22} {'COOLDOWN':>9}  KNOB")
+    for pol in snap["policies"]:
+        print(f"{pol['rule']:<18} {pol['action']:<22} "
+              f"{pol['cooldown_s']:>8g}s  {pol['knob']}")
+    print(f"actions={snap['actions_total']} "
+          f"suppressed={snap['suppressed_total']} "
+          f"pending_verifications={snap['pending_verifications']}")
+    recent = snap["recent"][-args.last:]
+    if recent:
+        print("recent actions (+ resolved, ~ persisted, ! worsened, "
+              "? pending):")
+        for act in recent:
+            print(action_line(act))
     return 0
 
 
@@ -1600,6 +1730,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", action="store_true",
                    help="print the raw verdict as JSON")
     p.set_defaults(fn=cmd_explain)
+
+    p = sub.add_parser(
+        "policies", help="autonomous operations: the policy table, "
+                         "recent remediations and their verified "
+                         "outcomes")
+    p.add_argument("--hosts", default="",
+                   help="pull each agent's recent policy actions "
+                        "instead of the local engine")
+    p.add_argument("--tpu", default="",
+                   help="TPU name: derive worker addresses via gcloud "
+                        "describe when --hosts is absent")
+    p.add_argument("--zone", default="")
+    p.add_argument("--port", type=int, default=0,
+                   help="port for portless --hosts entries / derived "
+                        "addresses")
+    p.add_argument("--flight", default="",
+                   help="narrate the anomaly -> action -> outcome "
+                        "chains of a flight artifact instead")
+    p.add_argument("--last", type=int, default=12,
+                   help="recent actions shown")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw snapshot / chains as JSON")
+    p.set_defaults(fn=cmd_policies)
 
     p = sub.add_parser("postmortem",
                        help="list/print black-box bundles (dead-worker "

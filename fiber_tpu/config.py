@@ -237,6 +237,26 @@ DEFAULTS: Dict[str, Any] = {
     # HBM fill fraction (bytes_in_use / bytes_limit, when the device
     # reports memory_stats) past which `hbm_fill` raises:
     "anomaly_hbm_fill_pct": 0.92,
+    # --- policy plane (docs/observability.md "Autonomous operations") ---
+    # Watchdog anomalies -> remediation actions (telemetry/policy.py):
+    # every action is a `policy` flight event linked to its anomaly via
+    # cause_id, and policy_verify_s later the engine re-samples the
+    # rule and records the outcome (resolved/persisted/worsened).
+    # Requires telemetry_enabled.
+    "policy_enabled": True,
+    # Record what WOULD be done without acting (planning/audit mode).
+    "policy_dry_run": False,
+    # Per-rule cooldown between repeated actions, seconds (a flapping
+    # rule must not re-fire its remediation every edge). The hbm_fill
+    # demote/promote pair is exempt: its hysteresis is the watchdog
+    # edge itself.
+    "policy_cooldown_s": 30.0,
+    # Delay before the engine re-samples a rule and classifies its
+    # action's outcome:
+    "policy_verify_s": 3.0,
+    # Comma-separated rule allowlist for the engine; "all" = every
+    # registered policy.
+    "policy_rules": "all",
     # --- accounting plane (docs/observability.md "Resource accounting") ---
     # Per-map/per-tenant cost attribution: billing keys ride the task
     # envelope tail, workers ship cumulative ("cost", ...) frames, and

@@ -28,8 +28,42 @@ from typing import Any, Callable, Iterable, List, Optional
 # LRU eviction bounds the growth that pinning would otherwise leak.
 # Meshes hash by value (devices + axis names), so equal meshes share.
 _CACHE_MAX = 128
+#: key -> (compiled, fingerprint); the fingerprint rides the entry so
+#: eviction can honor pins without recomputing it per pass.
 _compile_cache: "OrderedDict" = OrderedDict()
 _cache_lock = threading.Lock()
+#: Fingerprint prefixes whose entries LRU eviction must skip — the
+#: recompile_storm remediation (telemetry/policy.py): a storming
+#: program's own cache entry must not be the one churn evicts.
+#: Prefixes, not exact strings: the anomaly record truncates the
+#: fingerprint.
+_pinned_fps: set = set()
+
+
+def pin_fingerprint(prefix: str) -> int:
+    """Pin every compile-cache entry whose fingerprint starts with
+    ``prefix`` (current and future — the pin outlives the entries).
+    Returns how many entries match right now."""
+    prefix = str(prefix)
+    with _cache_lock:
+        _pinned_fps.add(prefix)
+        return sum(1 for _, fp in _compile_cache.values()
+                   if fp.startswith(prefix))
+
+
+def unpin_fingerprint(prefix: str) -> None:
+    """Drop one pin (the storm's clear-edge revert)."""
+    with _cache_lock:
+        _pinned_fps.discard(str(prefix))
+
+
+def pinned_fingerprints() -> list:
+    with _cache_lock:
+        return sorted(_pinned_fps)
+
+
+def _pinned_locked(fp: str) -> bool:
+    return any(fp.startswith(p) for p in _pinned_fps)
 
 
 def _stack_items(items: List[Any]):
@@ -89,14 +123,15 @@ def _compiled_mapper(fn: Callable, mesh, multi_arg: bool,
             cached = _compile_cache.get(key)
             if cached is not None:
                 _compile_cache.move_to_end(key)
-                return cached
+                return cached[0]
     # A compile-cache miss is a (re)compilation request for this logical
     # program: the device telemetry plane keys its recompile-storm
     # detector on this fingerprint (docs/observability.md) — the same
     # function compiling over and over is shape churn, not progress.
     from fiber_tpu.telemetry.device import DEVICE
 
-    DEVICE.note_compile(_fingerprint(fn, mesh))
+    fingerprint = _fingerprint(fn, mesh)
+    DEVICE.note_compile(fingerprint)
 
     if multi_arg and nb:
         def per_item(packed, *bc):
@@ -127,9 +162,16 @@ def _compiled_mapper(fn: Callable, mesh, multi_arg: bool,
     compiled = jax.jit(run, donate_argnums=(0,) if donate else ())
     if key is not None:
         with _cache_lock:
-            _compile_cache[key] = compiled
+            _compile_cache[key] = (compiled, fingerprint)
             while len(_compile_cache) > _CACHE_MAX:
-                _compile_cache.popitem(last=False)
+                # Oldest UNPINNED entry goes; a pinned fingerprint's
+                # program survives the storm that would churn it out.
+                victim = next(
+                    (k for k, (_, fp) in _compile_cache.items()
+                     if not _pinned_locked(fp)), None)
+                if victim is None:
+                    break  # everything pinned: stop evicting, not serving
+                del _compile_cache[victim]
     return compiled
 
 
@@ -256,3 +298,4 @@ def device_map(
 def clear_device_map_cache() -> None:
     with _cache_lock:
         _compile_cache.clear()
+        _pinned_fps.clear()

@@ -1336,6 +1336,17 @@ class Pool:
         self._monitor_probe = self._update_monitor_gauges
         TIMESERIES.add_probe(self._monitor_probe)
 
+        # Policy plane (docs/observability.md "Autonomous operations"):
+        # registering makes this pool's maps throttleable by billing
+        # key when the accounting watchdog raises budget_exceeded.
+        # Weak registration — the engine never pins a closed pool.
+        try:
+            from fiber_tpu.telemetry import policy as policymod
+
+            policymod.register_pool(self)
+        except Exception:  # noqa: BLE001 - observability, never fatal
+            pass
+
         self._result_thread = threading.Thread(
             target=self._result_loop, name="fiber-pool-results", daemon=True
         )
@@ -2258,6 +2269,28 @@ class Pool:
                 self._job_records.pop(next(iter(self._job_records)))
             accounting.write_job_record(job_id,
                                         self._cost_report_for(key))
+
+    def throttle_billing_key(self, key, factor: float = 4.0) -> int:
+        """Cut the WDRR weight of every in-flight map billed to
+        ``key`` (a (tenant, job, map) tuple — the policy plane's
+        budget_exceeded remediation). The maps keep progressing at the
+        scheduler's 0.25 weight floor; they just stop crowding out
+        in-budget tenants. Returns how many maps were throttled."""
+        key = tuple(key)
+        seqs = [seq for seq, bk in list(self._seq_bill.items())
+                if bk == key]
+        return sum(1 for seq in seqs
+                   if self._sched.throttle_map(seq, factor))
+
+    def unthrottle_billing_key(self, key) -> int:
+        """Restore the original weights (budget anomaly's clear-edge
+        revert). Maps that completed meanwhile already restored via
+        release_map; this covers the ones still running."""
+        key = tuple(key)
+        seqs = [seq for seq, bk in list(self._seq_bill.items())
+                if bk == key]
+        return sum(1 for seq in seqs
+                   if self._sched.unthrottle_map(seq))
 
     def cost(self, job_id: Optional[str] = None) -> Dict[str, Any]:
         """Per-map/per-tenant CostReports (docs/observability.md

@@ -174,6 +174,13 @@ class Scheduler:
         self._inflight_host: Dict[Tuple[Tuple[int, int], bytes],
                                   Optional[str]] = {}
         self._speculated: set = set()
+        #: seq -> original weight, for maps the policy plane throttled
+        #: (budget_exceeded remediation — telemetry/policy.py). The
+        #: original weight restores on unthrottle or map release.
+        self._throttled: Dict[int, float] = {}
+        #: saved speculation quantile while the policy plane's
+        #: straggler remediation holds it boosted (None = not boosted).
+        self._quantile_base: Optional[float] = None
         #: exact per-pool decision counts (the registry twins aggregate
         #: across pools; tests and Pool.stats() read these).
         self.decisions: Dict[str, int] = {
@@ -259,10 +266,66 @@ class Scheduler:
         # clears the serve threshold in one ring visit, so a lone
         # low-priority map can never stall its own handout waiting for
         # fractional credit to accumulate. Boost hot maps ABOVE 1
-        # instead of shrinking cold ones below it.
+        # instead of shrinking cold ones below it. (The ONE exception
+        # is throttle_map below — a deliberate sub-1 weight from the
+        # policy plane, bounded at 0.25 so the map still progresses.)
         with self._cond:
             st = self._ensure_map_locked(seq)
             st.weight = max(float(priority), 1.0)
+
+    # -- policy-plane hooks (telemetry/policy.py remediations) -----------
+    def throttle_map(self, seq: int, factor: float = 4.0) -> bool:
+        """Cut one map's WDRR weight by ``factor`` (budget_exceeded
+        remediation): the map keeps progressing — weight floors at
+        0.25, so it gets one chunk per ~4 ring cycles — but stops
+        crowding out in-budget tenants. Idempotent per map: a second
+        throttle re-divides the ORIGINAL weight, not the throttled
+        one. Returns whether the map exists."""
+        factor = max(1.0, min(float(factor), 4.0))
+        with self._cond:
+            st = self._maps.get(seq)
+            if st is None:
+                return False
+            original = self._throttled.setdefault(seq, st.weight)
+            st.weight = max(0.25, original / factor)
+            return True
+
+    def unthrottle_map(self, seq: int) -> bool:
+        """Restore a throttled map's original weight (the anomaly's
+        clear-edge revert)."""
+        with self._cond:
+            original = self._throttled.pop(seq, None)
+            st = self._maps.get(seq)
+            if original is None or st is None:
+                return False
+            st.weight = original
+            return True
+
+    def boost_speculation(self, factor: float = 0.5) -> bool:
+        """Lower the speculation quantile (straggler remediation):
+        duplicates fire at ``factor``× the configured age threshold.
+        Only meaningful when speculation is already on — the monitor
+        thread isn't started retroactively, and duplicates are only
+        safe for idempotent task functions (the pool's speculation
+        opt-in contract), so the policy plane must not force them on.
+        Returns whether a boost took effect."""
+        with self._cond:
+            if not self.speculation or self.closed:
+                return False
+            if self._quantile_base is None:
+                self._quantile_base = self._quantile
+            self._quantile = max(
+                1.0, self._quantile_base * max(0.1, float(factor)))
+            return True
+
+    def restore_speculation(self) -> bool:
+        """Undo boost_speculation (clear-edge revert)."""
+        with self._cond:
+            if self._quantile_base is None:
+                return False
+            self._quantile = self._quantile_base
+            self._quantile_base = None
+            return True
 
     def register_chunk(self, key: Tuple[int, int],
                        digests: Iterable[str]) -> None:
@@ -278,6 +341,7 @@ class Scheduler:
         metadata. Fired from the map's completion callback."""
         with self._cond:
             st = self._maps.pop(seq, None)
+            self._throttled.pop(seq, None)
             if st is not None:
                 self._queued -= len(st.queue)
                 st.queue.clear()
@@ -415,7 +479,11 @@ class Scheduler:
         # then rotates — so over one full ring cycle map i gets
         # weight_i chunks. A map that is ineligible for THIS requester
         # (only its own speculative dup queued) is skipped uncharged.
-        for _ in range(2 * len(self._ring) + 2):
+        # The loop bound covers throttled maps too: a 0.25-weight map
+        # needs 4 refill visits before it can serve, so a ring of
+        # nothing but throttled maps must still hand out within one
+        # call.
+        for _ in range(4 * len(self._ring) + 8):
             if not self._ring:
                 return None
             seq = self._ring[0]

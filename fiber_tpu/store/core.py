@@ -384,10 +384,12 @@ class LocalStore:
             return None
         return data
 
-    def _trim_disk(self) -> None:
-        """Keep the disk tier under max_disk_bytes, oldest-mtime first
-        (best effort — concurrent processes may race; losing a cache
-        file only costs a re-fetch)."""
+    def _trim_disk(self, target: Optional[int] = None) -> None:
+        """Keep the disk tier under ``target`` bytes (default
+        max_disk_bytes), oldest-mtime first (best effort — concurrent
+        processes may race; losing a cache file only costs a
+        re-fetch)."""
+        bound = self.max_disk_bytes if target is None else int(target)
         try:
             names = [n for n in os.listdir(self.root)
                      if n.endswith(".obj")]
@@ -403,7 +405,7 @@ class LocalStore:
                 total += st.st_size
             files.sort()
             for _, size, p in files:
-                if total <= self.max_disk_bytes:
+                if total <= bound:
                     break
                 try:
                     os.unlink(p)
@@ -412,6 +414,18 @@ class LocalStore:
                     pass
         except OSError:
             pass
+
+    def shed_disk(self, fraction: float = 0.7) -> int:
+        """Evict down to ``fraction`` of the disk budget NOW, oldest
+        first (the policy plane's store_disk_fill remediation — the
+        watchdog fires at 90% of budget, so trimming only to 100% would
+        never clear the anomaly). Returns bytes freed."""
+        if self.root is None:
+            return 0
+        before = self.disk_usage()
+        self._trim_disk(target=int(
+            self.max_disk_bytes * max(0.0, min(1.0, float(fraction)))))
+        return max(0, before - self.disk_usage())
 
     def disk_usage(self) -> int:
         """Bytes currently held by the disk tier (spill + host cache),

@@ -39,6 +39,13 @@ class Replicator:
         self._refs: Dict[str, int] = {}
         self.replicated_total = 0
         self.failed_total = 0
+        #: Backend-installed entry point for policy-driven replication
+        #: (callable(reason) -> copies made). The backend's suspect
+        #: handler calls replicate_for_suspect directly; the policy
+        #: plane goes through drive() below because it has no suspect,
+        #: only an anomaly (heartbeat_age / throughput_drop).
+        self._driver = None
+        self.driven_total = 0
 
     # -- registry --------------------------------------------------------
     def note(self, digests: Iterable[str]) -> None:
@@ -63,7 +70,42 @@ class Replicator:
         with self._lock:
             return {"precious": len(self._refs),
                     "replicated": self.replicated_total,
-                    "failed": self.failed_total}
+                    "failed": self.failed_total,
+                    "driven": self.driven_total}
+
+    # -- policy-plane driver ---------------------------------------------
+    def register_driver(self, fn) -> None:
+        """Install the backend's pre-emptive replication entry point
+        (``fn(reason) -> int``). Last registration wins — one live
+        backend per process is the operating regime."""
+        with self._lock:
+            self._driver = fn
+
+    def has_driver(self) -> bool:
+        with self._lock:
+            return self._driver is not None
+
+    def drive(self, reason: str = "policy") -> bool:
+        """Kick one pre-emptive replication pass on a throwaway thread
+        (same isolation posture as the suspect handler — replication
+        must never wedge the caller, here the watchdog's anomaly hook).
+        Returns whether a pass was started."""
+        with self._lock:
+            fn = self._driver
+        if fn is None or not self.precious():
+            return False
+
+        def _run() -> None:
+            try:
+                fn(reason)
+            except Exception:  # noqa: BLE001 - bonus, never load-bearing
+                logger.exception("store: driven replication failed")
+
+        threading.Thread(target=_run, name="fiber-store-replicate",
+                         daemon=True).start()
+        with self._lock:
+            self.driven_total += 1
+        return True
 
     # -- copy routine ----------------------------------------------------
     def replicate_for_suspect(self, suspect_key: str, targets,

@@ -132,6 +132,12 @@ def refresh() -> None:
     from fiber_tpu.telemetry.accounting import COSTS
 
     COSTS.configure(cfg)
+    # Policy plane (docs/observability.md "Autonomous operations"):
+    # watchdog anomalies -> remediation actions with verified outcomes.
+    # Lazy import, same posture as monitor/device/accounting above.
+    from fiber_tpu.telemetry.policy import POLICY
+
+    POLICY.configure(cfg)
 
 
 def snapshot() -> Dict[str, Any]:
@@ -168,7 +174,19 @@ def snapshot() -> Dict[str, Any]:
         "sched": sched_snaps,
         "device": _device_snapshot(),
         "costs": _cost_snapshot(),
+        "policy": _policy_snapshot(),
     }
+
+
+def _policy_snapshot() -> Dict[str, Any]:
+    """Policy-plane surface for the generic snapshot (null-safe: a
+    snapshot must never fail)."""
+    try:
+        from fiber_tpu.telemetry.policy import POLICY
+
+        return POLICY.snapshot()
+    except Exception:  # pragma: no cover - snapshot must never fail
+        return {}
 
 
 def _cost_snapshot() -> Dict[str, Any]:

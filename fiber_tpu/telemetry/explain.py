@@ -302,6 +302,77 @@ def explain_trace(spans: Sequence[Dict[str, Any]],
     }
 
 
+def policy_chains(events: Iterable[Dict[str, Any]]
+                  ) -> List[Dict[str, Any]]:
+    """Join anomaly -> action -> outcome by event id (docs/
+    observability.md "Autonomous operations"): every ``monitor``-plane
+    anomaly event is a potential cause; ``policy``-plane events carry
+    ``cause_id`` pointing back at it. Returns one chain per anomaly
+    that drew ANY policy activity (actions, suppressions, reverts,
+    outcomes), in event order."""
+    events = list(events)
+    anomalies: Dict[str, Dict[str, Any]] = {
+        e["id"]: e for e in events
+        if e.get("plane") == "monitor" and e.get("kind") != "clear"
+        and e.get("id")}
+    chains: Dict[str, Dict[str, Any]] = {}
+    order: List[str] = []
+    for e in events:
+        if e.get("plane") != "policy":
+            continue
+        cid = e.get("cause_id")
+        if not cid:
+            continue
+        chain = chains.get(cid)
+        if chain is None:
+            chain = chains[cid] = {
+                "cause_id": cid,
+                "anomaly": anomalies.get(cid),
+                "actions": [], "outcomes": [], "notes": [],
+            }
+            order.append(cid)
+        kind = e.get("kind")
+        if kind == "outcome":
+            chain["outcomes"].append(e)
+        elif kind in ("suppressed", "revert"):
+            chain["notes"].append(e)
+        else:
+            chain["actions"].append(e)
+    return [chains[cid] for cid in order]
+
+
+def render_chains(chains: Sequence[Dict[str, Any]]) -> str:
+    """Narrate the anomaly -> action -> outcome chains (the CLI's
+    ``explain --flight`` tail and ``fiber-tpu policies --events``)."""
+    if not chains:
+        return "autonomous operations: no policy activity recorded"
+    lines = [f"autonomous operations: {len(chains)} anomaly chain(s)"]
+    for chain in chains:
+        anom = chain.get("anomaly")
+        if anom is not None:
+            rule = anom.get("kind", "?")
+            detail = anom.get("detail", "")
+            lines.append(f"anomaly {rule} [{chain['cause_id']}]: {detail}")
+        else:
+            lines.append(f"anomaly [{chain['cause_id']}] "
+                         "(event outside this artifact)")
+        for act in chain["actions"]:
+            mode = ("dry-run" if act.get("dry_run")
+                    else ("applied" if act.get("applied") else "no-op"))
+            lines.append(f"  -> action {act.get('kind')} ({mode}): "
+                         f"{act.get('detail', '')}")
+        for note in chain["notes"]:
+            lines.append(f"  .. {note.get('kind')}: "
+                         f"{note.get('reason') or note.get('detail', '')}")
+        for out in chain["outcomes"]:
+            lines.append(f"  => outcome {out.get('outcome')}: "
+                         f"{out.get('detail', '')}")
+        if chain["actions"] and not chain["outcomes"]:
+            lines.append("  => outcome pending (verification had not "
+                         "run when the artifact was written)")
+    return "\n".join(lines)
+
+
 def render(verdict: Dict[str, Any]) -> str:
     """Human-readable ranked budget (the CLI's output)."""
     lines = [

@@ -37,14 +37,16 @@ into a black-box bundle under the staging root.
 from __future__ import annotations
 
 import collections
+import os
 import threading
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 #: Planes the hooks report under (documentation + explain.py grouping;
 #: record() does not enforce membership — a new plane must not need a
 #: central registry edit to start reporting).
-PLANES = ("pool", "sched", "store", "transport", "health", "agent")
+PLANES = ("pool", "sched", "store", "transport", "health", "agent",
+          "policy")
 
 
 class FlightRecorder:
@@ -58,12 +60,18 @@ class FlightRecorder:
         self.dropped = 0    # lifetime events evicted by the ring bound
         self.recorded = 0   # lifetime events accepted
 
-    def record(self, plane: str, kind: str, **attrs: Any) -> None:
-        """Append one event. Call sites on hot paths should guard with
-        ``if FLIGHT.enabled:`` so the kwargs dict is never built when
-        the recorder is off."""
+    def record(self, plane: str, kind: str, **attrs: Any) -> Optional[str]:
+        """Append one event and return its id (None when disabled).
+        Call sites on hot paths should guard with ``if FLIGHT.enabled:``
+        so the kwargs dict is never built when the recorder is off.
+
+        The id is ``"<pid>-<n>"`` with ``n`` this recorder's lifetime
+        accept count: stable, per-process monotonic, and unique across
+        the processes whose buffers a postmortem merge concatenates —
+        so a ``cause_id`` link (the policy plane's anomaly -> action ->
+        outcome chain) survives ``order_events`` re-sorting."""
         if not self.enabled:
-            return
+            return None
         # Dual clocks on every event: "ts" (wall) is comparable across
         # hosts but subject to NTP steps; "mono" orders events from ONE
         # process exactly. Cross-process merges (explain --flight, the
@@ -77,8 +85,11 @@ class FlightRecorder:
         with self._lock:
             if len(self._events) == self._events.maxlen:
                 self.dropped += 1
-            self._events.append(event)
             self.recorded += 1
+            eid = f"{os.getpid()}-{self.recorded}"
+            event["id"] = eid
+            self._events.append(event)
+        return eid
 
     def snapshot(self, last: int = 0) -> List[Dict[str, Any]]:
         """Copy of the buffered events, oldest first (``last`` > 0
@@ -112,9 +123,9 @@ class FlightRecorder:
 FLIGHT = FlightRecorder()
 
 
-def record(plane: str, kind: str, **attrs: Any) -> None:
+def record(plane: str, kind: str, **attrs: Any) -> Optional[str]:
     """Module-level convenience for cold call sites."""
-    FLIGHT.record(plane, kind, **attrs)
+    return FLIGHT.record(plane, kind, **attrs)
 
 
 def order_events(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:

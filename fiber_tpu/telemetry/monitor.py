@@ -46,6 +46,7 @@ from typing import Any, Deque, Dict, List, Optional
 
 from fiber_tpu import telemetry
 from fiber_tpu.telemetry.flightrec import FLIGHT
+from fiber_tpu.telemetry.policy import POLICY
 from fiber_tpu.utils.logging import get_logger
 
 logger = get_logger()
@@ -100,22 +101,50 @@ class AnomalyWatchdog:
             "wall": time.time(), "mono": time.monotonic(),
         }
         record.update(attrs)
+        # The anomaly's flight-event id is the cause_id every linked
+        # policy/outcome event carries (the explain chain's join key).
+        record["id"] = FLIGHT.record("monitor", rule, detail=detail,
+                                     **attrs)
         self._active[rule] = record
         self._recent.append(record)
         self.total += 1
         _m_anomalies.inc(rule=rule)
-        FLIGHT.record("monitor", rule, detail=detail, **attrs)
         logger.warning("monitor: anomaly %s — %s", rule, detail)
+        # Policy plane (telemetry/policy.py): the breach edge is the
+        # remediation trigger. Called under self._lock — same posture
+        # as the old hardwired device-tier arm; the engine must never
+        # call back into this watchdog.
+        try:
+            POLICY.on_anomaly(self, rule, record)
+        except Exception:  # noqa: BLE001 - policy must not break detection
+            logger.exception("monitor: policy hook failed for %s", rule)
 
     def _clear_anomaly(self, rule: str) -> None:
-        if self._active.pop(rule, None) is not None:
-            FLIGHT.record("monitor", "clear", rule=rule)
+        record = self._active.pop(rule, None)
+        if record is not None:
+            FLIGHT.record("monitor", "clear", rule=rule,
+                          cause_id=record.get("id"))
             logger.info("monitor: anomaly %s cleared", rule)
+            # Clear edge reverts the rule's applied remediation
+            # (promote the tier, restore weights/high-water/...).
+            try:
+                POLICY.on_clear(self, rule, record)
+            except Exception:  # noqa: BLE001 - policy must not break
+                # detection
+                logger.exception(
+                    "monitor: policy clear hook failed for %s", rule)
 
     def _edge(self, rule: str, breached: bool, detail: str = "",
               **attrs: Any) -> None:
         if breached and rule not in self._active:
             self._raise_anomaly(rule, detail, **attrs)
+        elif breached:
+            # Still breached: refresh the standing record's severity
+            # attrs in place (no new event — breaches stay edges). The
+            # policy engine's outcome verification compares these
+            # against their action-time values (resolved / persisted /
+            # worsened).
+            self._active[rule].update(attrs, detail=detail)
         elif not breached and rule in self._active:
             self._clear_anomaly(rule)
 
@@ -123,6 +152,13 @@ class AnomalyWatchdog:
     def observe(self, sample: Dict[str, Any]) -> None:
         with self._lock:
             self._observe_locked(sample)
+        # Outcome verification rides the same tick, AFTER the lock
+        # drops: the engine re-samples rule state through this
+        # watchdog's lock (telemetry/policy.py).
+        try:
+            POLICY.poll()
+        except Exception:  # noqa: BLE001 - policy must not break detection
+            logger.exception("monitor: policy verification failed")
 
     def _observe_locked(self, sample: Dict[str, Any]) -> None:
         # 1. throughput collapse vs the trailing window
@@ -195,21 +231,17 @@ class AnomalyWatchdog:
 
         # 6. HBM fill (device telemetry plane; both fields None on CPU
         # or when no device runtime exists — honest null, no breach).
-        # This rule REMEDIATES, not just observes: on the breach edge
-        # the device store tier is demoted to the host tiers (its HBM
-        # is the one allocation the runtime can safely shed — the host
-        # store still holds every byte), and re-promoted on the clear
-        # edge. Closed loop, flight-evented by the tier itself.
+        # This rule REMEDIATES, not just observes: the policy engine's
+        # hbm_fill policy (telemetry/policy.py, the refactored PR-13
+        # arm) demotes the device store tier on the breach edge and
+        # re-promotes on the clear edge — closed loop, flight-evented
+        # by the tier itself plus the engine's policy/outcome chain.
         used, limit = _hbm_usage()
-        hbm_breached = limit > 0 and used > self.hbm_fill_pct * limit
-        hbm_was_active = "hbm_fill" in self._active
         self._edge(
-            "hbm_fill", hbm_breached,
+            "hbm_fill", limit > 0 and used > self.hbm_fill_pct * limit,
             detail=(f"HBM {used >> 20}MB > "
                     f"{self.hbm_fill_pct:.0%} of {limit >> 20}MB"),
             bytes=used, limit=limit)
-        if hbm_breached != hbm_was_active:
-            _device_tier_remediate(demote=hbm_breached)
 
         # 7. recompile storm: one fingerprint compiling repeatedly
         # inside the device plane's window — shape churn, not progress
@@ -299,26 +331,6 @@ def _store_disk_usage() -> "tuple[int, int]":
         return 0, 0
 
 
-def _device_tier_remediate(demote: bool) -> None:
-    """The ``hbm_fill`` rule's remediation arm: demote the device store
-    tier on the breach edge, re-promote on the clear edge. Peek-only —
-    a host with no device tier has nothing to shed, and the watchdog
-    must never instantiate one (monkeypatchable in tests, like
-    ``_store_disk_usage``)."""
-    try:
-        from fiber_tpu import store as storemod
-
-        tier = storemod._dtier  # peek, never instantiate
-        if tier is None:
-            return
-        if demote:
-            tier.demote("hbm_fill")
-        else:
-            tier.promote()
-    except Exception:  # noqa: BLE001 - monitoring must not fail
-        logger.exception("monitor: device-tier remediation failed")
-
-
 #: Process-wide watchdog; registered as a TIMESERIES observer by
 #: telemetry.refresh().
 WATCHDOG = AnomalyWatchdog()
@@ -341,6 +353,10 @@ def monitor_payload(history: int = 120) -> Dict[str, Any]:
                 for k, v in health.heartbeat_ages().items()}
     except Exception:  # noqa: BLE001
         ages = {}
+    try:
+        actions = POLICY.recent_actions(8)
+    except Exception:  # noqa: BLE001
+        actions = []
     return {
         "host": tracing.host_id(),
         "pid": _os.getpid(),
@@ -348,6 +364,9 @@ def monitor_payload(history: int = 120) -> Dict[str, Any]:
         "anomalies": WATCHDOG.snapshot(),
         "heartbeat_ages": ages,
         "device": _device_summary(),
+        # Autonomous operations: what this host's policy engine DID
+        # about the anomalies above (`fiber-tpu top` action feed).
+        "policy": actions,
     }
 
 

@@ -1,0 +1,532 @@
+"""Policy plane: autonomous remediation riding the anomaly watchdog
+(docs/observability.md "Autonomous operations").
+
+Every plane so far *reports*; this one *acts*. The watchdog's
+edge-triggered anomalies (telemetry/monitor.py) are the triggers: on a
+breach edge the engine looks up the rule's registered policy, runs its
+remediation, and records the act as a ``policy`` flight event whose
+``cause_id`` links back to the anomaly's own event id — so ``fiber-tpu
+explain --flight`` narrates the full *anomaly → action → outcome*
+chain instead of leaving the operator to correlate timestamps.
+
+The remediation set (ROADMAP item 5, one registered policy per rule):
+
+====================  =================================================
+hbm_fill              demote the device store tier to the host tiers
+                      (the PR-13 arm, now the engine's first policy);
+                      re-promote on the clear edge
+recompile_storm       pin the offending fingerprint's compile-cache
+                      entries so LRU churn stops re-evicting the storm's
+                      own program; unpin on clear
+heartbeat_age /       pre-emptively replicate precious digests (the
+throughput_drop       suspect-time path, run EARLY) and boost straggler
+                      speculation on live schedulers; restore on clear
+store_disk_fill       LRU eviction pressure: trim the disk tier below
+                      the fill threshold
+budget_exceeded       throttle the offending (tenant, job): cut the WDRR
+                      weight of its in-flight maps (the PR-10 hook);
+                      restore on clear
+tx_queue_high         tighten the transport TX high-water so senders
+                      feel backpressure earlier; restore on clear
+====================  =================================================
+
+Verification closes the loop: ``policy_verify_s`` after an action the
+engine re-samples the rule through the raising watchdog and classifies
+the **outcome** — ``resolved`` (the rule cleared), ``persisted`` (still
+breached, severity flat) or ``worsened`` (severity degraded ≥5%) — as
+both an ``outcome`` flight event and the ``policy_actions`` counter
+(labels rule/action/outcome). ``policy_dry_run`` records what *would*
+have been done without acting; per-rule cooldowns stop a flapping rule
+from re-firing its action every edge (the hbm_fill demote/promote pair
+is exempt — its hysteresis lives in the watchdog edge itself, and the
+PR-13 drills require every breach edge to demote).
+
+Concurrency contract: ``on_anomaly``/``on_clear`` run UNDER the raising
+watchdog's lock (the same posture as the old hardwired arm), so actions
+must never call back into a watchdog. Verification (``poll``) runs
+outside it — after ``observe`` releases, or from any caller.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import weakref
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+from fiber_tpu import telemetry
+from fiber_tpu.telemetry.flightrec import FLIGHT
+from fiber_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+_m_actions = telemetry.counter(
+    "policy_actions",
+    "Policy-plane remediations, by rule/action/verified outcome")
+
+#: Recent action records kept for the operator surface (`fiber-tpu
+#: policies`, the `top` feed, monitor_payload).
+MAX_RECENT = 64
+
+#: Severity attr per rule for outcome classification: (key, direction)
+#: — direction +1 means a larger value is worse, -1 means smaller is
+#: worse. Compared between the action-time anomaly record and the
+#: re-sampled record after policy_verify_s (the watchdog refreshes a
+#: standing anomaly's attrs each tick).
+RULE_SEVERITY: Dict[str, Tuple[str, int]] = {
+    "throughput_drop": ("rate", -1),
+    "queue_growth": ("depth", +1),
+    "heartbeat_age": ("age_s", +1),
+    "store_disk_fill": ("bytes", +1),
+    "tx_queue_high": ("bytes", +1),
+    "hbm_fill": ("bytes", +1),
+    "recompile_storm": ("count", +1),
+    "budget_exceeded": ("observed", +1),
+}
+
+#: Fractional severity degradation that upgrades "persisted" to
+#: "worsened".
+WORSE_PCT = 0.05
+
+#: Pools registered for billing-key resolution (budget_exceeded
+#: throttling) — weak so a closed pool drops out without bookkeeping.
+_POOLS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_pool(pool) -> None:
+    """Called by Pool.__init__: the budget_exceeded policy resolves a
+    billing key to in-flight maps through every registered pool's
+    ``throttle_billing_key`` hook."""
+    _POOLS.add(pool)
+
+
+# ---------------------------------------------------------------------------
+# remediation actions
+#
+# Each is ``fn(record, dry_run) -> (applied, detail, revert)``: the
+# anomaly record supplies the offender (fingerprint, billing key, ...),
+# ``detail`` narrates what was (or would be) done, ``revert`` (optional)
+# runs on the rule's clear edge. All targets are PEEKED, never
+# instantiated — a process without the subsystem has nothing to remediate
+# (the watchdog's `_store_disk_usage` convention).
+# ---------------------------------------------------------------------------
+
+
+def _act_hbm_fill(record: Dict[str, Any], dry_run: bool):
+    """The PR-13 arm, refactored from monitor._device_tier_remediate:
+    demote the device store tier on the breach edge (its HBM is the one
+    allocation the runtime can safely shed — the host store still holds
+    every byte), re-promote on the clear edge via the revert."""
+    from fiber_tpu import store as storemod
+
+    tier = storemod._dtier  # peek, never instantiate
+    if tier is None:
+        return False, "no device store tier in this process", None
+    if dry_run:
+        return False, "would demote the device store tier to host RAM", None
+    freed = tier.demote("hbm_fill")
+
+    def revert() -> None:
+        t = storemod._dtier
+        if t is not None:
+            t.promote()
+
+    return True, (f"demoted device store tier "
+                  f"({freed} bytes shed to the host tiers)"), revert
+
+
+def _act_recompile_storm(record: Dict[str, Any], dry_run: bool):
+    fp = str(record.get("fingerprint") or "")
+    if not fp or fp == "None":
+        return False, "storm fingerprint unknown; nothing to pin", None
+    from fiber_tpu.parallel import dmap
+
+    if dry_run:
+        return False, f"would pin compile-cache entries for {fp!r}", None
+    n = dmap.pin_fingerprint(fp)
+
+    def revert() -> None:
+        dmap.unpin_fingerprint(fp)
+
+    return True, (f"pinned {n} compile-cache entr"
+                  f"{'y' if n == 1 else 'ies'} for {fp!r} — LRU "
+                  "eviction skips them while the storm lasts"), revert
+
+
+def _act_straggler(record: Dict[str, Any], dry_run: bool):
+    """heartbeat_age / throughput_drop: run the suspect-time precious
+    replication EARLY (while 'trouble brewing' is still cheap to hedge)
+    and tighten straggler speculation so duplicates fire sooner.
+    Speculation is only boosted where it is already enabled — duplicates
+    are only safe for idempotent functions, and the policy plane must
+    not widen that contract."""
+    from fiber_tpu.sched.core import _LIVE
+    from fiber_tpu.store.replicate import REPLICATOR
+
+    scheds = [s for s in list(_LIVE) if not s.closed and s.speculation]
+    if dry_run:
+        driver = ("registered" if REPLICATOR.has_driver()
+                  else "not registered")
+        return False, (f"would replicate precious digests (driver "
+                       f"{driver}) and boost speculation on "
+                       f"{len(scheds)} scheduler(s)"), None
+    boosted = [s for s in scheds if s.boost_speculation()]
+    drove = REPLICATOR.drive(reason=str(record.get("rule") or "policy"))
+    parts = []
+    if drove:
+        parts.append("kicked pre-emptive precious replication")
+    else:
+        parts.append("replication skipped (no driver or nothing "
+                     "precious)")
+    if boosted:
+        parts.append(f"boosted speculation on {len(boosted)} "
+                     "scheduler(s)")
+    else:
+        parts.append("no speculation-enabled scheduler to boost")
+    applied = bool(boosted) or drove
+
+    def revert() -> None:
+        for s in boosted:
+            try:
+                s.restore_speculation()
+            except Exception:  # noqa: BLE001 - best-effort restore
+                pass
+
+    return applied, "; ".join(parts), (revert if boosted else None)
+
+
+def _act_store_disk_fill(record: Dict[str, Any], dry_run: bool):
+    from fiber_tpu import store as storemod
+
+    st = storemod._store  # peek, never instantiate
+    if st is None or st.root is None:
+        return False, "no store disk tier in this process", None
+    if dry_run:
+        return False, ("would trim the disk tier to 70% of "
+                       "max_disk_bytes"), None
+    freed = st.shed_disk(0.7)
+    return True, (f"LRU eviction pressure: trimmed the disk tier by "
+                  f"{freed} bytes (target 70% of its bound)"), None
+
+
+def _act_budget(record: Dict[str, Any], dry_run: bool):
+    from fiber_tpu.telemetry.accounting import key_str, parse_key
+
+    key = parse_key(str(record.get("key") or ""))
+    if dry_run:
+        return False, (f"would cut the WDRR weight of maps billed to "
+                       f"{key_str(key)} by 4x"), None
+    hit: List[Tuple["weakref.ref", Tuple[str, str, str]]] = []
+    n = 0
+    for pool in list(_POOLS):
+        try:
+            throttled = pool.throttle_billing_key(key, factor=4.0)
+        except Exception:  # noqa: BLE001 - one pool must not stop the rest
+            logger.exception("policy: budget throttle failed")
+            continue
+        if throttled:
+            n += throttled
+            hit.append((weakref.ref(pool), key))
+    if not n:
+        return False, (f"no in-flight map billed to {key_str(key)} "
+                       "in this process"), None
+
+    def revert() -> None:
+        for pref, k in hit:
+            p = pref()
+            if p is not None:
+                try:
+                    p.unthrottle_billing_key(k)
+                except Exception:  # noqa: BLE001 - best-effort restore
+                    pass
+
+    return True, (f"throttled {n} in-flight map(s) billed to "
+                  f"{key_str(key)}: WDRR weight cut 4x"), revert
+
+
+def _act_tx_queue_high(record: Dict[str, Any], dry_run: bool):
+    from fiber_tpu.transport import evloop
+
+    old = int(evloop.TX_HIGH_WATER)
+    new = max(4 << 20, old // 2)
+    if new >= old:
+        return False, (f"TX high-water already at its "
+                       f"{old >> 20}MB floor"), None
+    if dry_run:
+        return False, (f"would tighten TX high-water "
+                       f"{old >> 20}MB -> {new >> 20}MB"), None
+    evloop.set_tx_high_water(new)
+
+    def revert() -> None:
+        evloop.set_tx_high_water(old)
+
+    return True, (f"tightened TX high-water {old >> 20}MB -> "
+                  f"{new >> 20}MB — senders feel backpressure "
+                  "earlier"), revert
+
+
+class Policy:
+    """One rule -> action binding (declarative row of the engine)."""
+
+    __slots__ = ("rule", "action", "func", "knob", "cooldown_s")
+
+    def __init__(self, rule: str, action: str, func: Callable,
+                 knob: str = "", cooldown_s: Optional[float] = None) -> None:
+        self.rule = rule
+        self.action = action
+        self.func = func
+        self.knob = knob            # the config knob that tunes the rule
+        self.cooldown_s = cooldown_s  # None = engine default
+
+
+#: The shipped policy table (docs/observability.md "Autonomous
+#: operations"). hbm_fill's cooldown is 0: the demote/promote pair must
+#: track every watchdog edge exactly (the PR-13 behavior contract).
+_DEFAULT_POLICIES: Tuple[Policy, ...] = (
+    Policy("hbm_fill", "demote_device_tier", _act_hbm_fill,
+           knob="anomaly_hbm_fill_pct", cooldown_s=0.0),
+    Policy("recompile_storm", "pin_compile_cache", _act_recompile_storm,
+           knob="anomaly_recompile_count"),
+    Policy("heartbeat_age", "replicate_and_boost", _act_straggler,
+           knob="suspect_timeout"),
+    Policy("throughput_drop", "replicate_and_boost", _act_straggler,
+           knob="anomaly_drop_pct"),
+    Policy("store_disk_fill", "shed_store_disk", _act_store_disk_fill,
+           knob="anomaly_disk_fill_pct"),
+    Policy("budget_exceeded", "throttle_tenant", _act_budget,
+           knob="CostBudget caps"),
+    Policy("tx_queue_high", "tighten_tx_highwater", _act_tx_queue_high,
+           knob="anomaly_tx_queue_mb"),
+)
+
+
+class PolicyEngine:
+    """Anomaly -> remediation dispatch + outcome verification."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # knobs (refreshed from config via configure())
+        self.enabled = True
+        self.dry_run = False
+        self.cooldown_s = 30.0
+        self.verify_s = 3.0
+        self._rules_filter: Optional[set] = None  # None = all rules
+        # the policy table
+        self._policies: Dict[str, Policy] = {
+            p.rule: p for p in _DEFAULT_POLICIES}
+        # state
+        self._last_action: Dict[str, float] = {}   # rule -> mono stamp
+        self._applied: Dict[str, Dict[str, Any]] = {}  # rule -> revert
+        self._pending: List[Dict[str, Any]] = []   # verification queue
+        self._recent: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=MAX_RECENT)
+        self.actions_total = 0
+        self.suppressed_total = 0
+
+    def configure(self, cfg) -> None:
+        """Re-read the policy knobs (telemetry.refresh)."""
+        self.enabled = bool(cfg.telemetry_enabled) \
+            and bool(cfg.policy_enabled)
+        self.dry_run = bool(cfg.policy_dry_run)
+        self.cooldown_s = max(0.0, float(cfg.policy_cooldown_s))
+        self.verify_s = max(0.05, float(cfg.policy_verify_s))
+        rules = str(cfg.policy_rules).strip().lower()
+        if rules in ("", "all", "*"):
+            self._rules_filter = None
+        else:
+            self._rules_filter = {r.strip() for r in rules.split(",")
+                                  if r.strip()}
+
+    # -- watchdog hooks (called UNDER the raising watchdog's lock) ------
+    def on_anomaly(self, dog, rule: str,
+                   record: Dict[str, Any]) -> None:
+        """Breach edge: run the rule's policy (if any). ``record`` is
+        the watchdog's anomaly record — its ``id`` (the anomaly's
+        flight-event id) becomes every linked event's ``cause_id``."""
+        if not self.enabled:
+            return
+        pol = self._policies.get(rule)
+        if pol is None:
+            return
+        if self._rules_filter is not None \
+                and rule not in self._rules_filter:
+            return
+        now = time.monotonic()
+        cause_id = record.get("id")
+        with self._lock:
+            cd = (self.cooldown_s if pol.cooldown_s is None
+                  else pol.cooldown_s)
+            last = self._last_action.get(rule)
+            if last is not None and cd > 0 and (now - last) < cd:
+                self.suppressed_total += 1
+                FLIGHT.record(
+                    "policy", "suppressed", rule=rule,
+                    action=pol.action, cause_id=cause_id,
+                    reason=(f"cooldown: last action "
+                            f"{now - last:.1f}s ago < {cd:g}s"))
+                return
+            self._last_action[rule] = now
+        try:
+            applied, detail, revert = pol.func(record, self.dry_run)
+        except Exception:  # noqa: BLE001 - a policy must never take
+            # the watchdog (and the sampler thread) down with it
+            logger.exception("policy: %s action %s failed",
+                             rule, pol.action)
+            applied, detail, revert = False, "action raised; see log", None
+        act: Dict[str, Any] = {
+            "rule": rule, "action": pol.action,
+            "wall": time.time(), "mono": now,
+            "cause_id": cause_id, "applied": bool(applied),
+            "dry_run": bool(self.dry_run), "detail": detail,
+            "outcome": None,
+        }
+        act["id"] = FLIGHT.record(
+            "policy", pol.action, rule=rule, cause_id=cause_id,
+            applied=bool(applied), dry_run=bool(self.dry_run) or None,
+            detail=detail)
+        with self._lock:
+            self._recent.append(act)
+            self.actions_total += 1
+            if applied and revert is not None:
+                self._applied[rule] = {"revert": revert,
+                                       "dog": weakref.ref(dog)}
+            sev = RULE_SEVERITY.get(rule)
+            baseline = (record.get(sev[0]) if sev else None)
+            self._pending.append({
+                "due": now + self.verify_s, "rule": rule, "act": act,
+                "dog": weakref.ref(dog), "baseline": baseline,
+            })
+        logger.warning("policy: %s -> %s%s — %s", rule, pol.action,
+                       " [dry-run]" if self.dry_run else "", detail)
+
+    def on_clear(self, dog, rule: str,
+                 record: Optional[Dict[str, Any]] = None) -> None:
+        """Clear edge: run the applied action's revert (promote the
+        tier, unpin the fingerprint, restore speculation/weights/
+        high-water). Only the watchdog that triggered the action (or a
+        dead one) reverts — a second watchdog instance clearing the
+        same rule name must not undo another's remediation."""
+        entry = None
+        with self._lock:
+            e = self._applied.get(rule)
+            if e is not None:
+                d = e["dog"]()
+                if d is None or d is dog:
+                    entry = self._applied.pop(rule)
+        if entry is None:
+            return
+        try:
+            entry["revert"]()
+        except Exception:  # noqa: BLE001 - revert must not take the
+            # watchdog down
+            logger.exception("policy: %s revert failed", rule)
+            return
+        cause_id = (record or {}).get("id")
+        FLIGHT.record("policy", "revert", rule=rule, cause_id=cause_id,
+                      detail="rule cleared; remediation reverted")
+
+    # -- outcome verification -------------------------------------------
+    def poll(self, now: Optional[float] = None) -> int:
+        """Classify every due verification (called after each watchdog
+        tick, outside its lock; tests pass ``now`` to force due).
+        Returns how many outcomes were emitted."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            due = [e for e in self._pending if e["due"] <= now]
+            if due:
+                self._pending = [e for e in self._pending
+                                 if e["due"] > now]
+        for entry in due:
+            self._verify(entry)
+        return len(due)
+
+    def _verify(self, entry: Dict[str, Any]) -> None:
+        rule = entry["rule"]
+        act = entry["act"]
+        dog = entry["dog"]()
+        current = None
+        if dog is not None:
+            with dog._lock:
+                rec = dog._active.get(rule)
+                current = dict(rec) if rec is not None else None
+        if current is None:
+            outcome = "resolved"
+        else:
+            outcome = "persisted"
+            sev = RULE_SEVERITY.get(rule)
+            base = entry.get("baseline")
+            if sev is not None and base is not None:
+                cur = current.get(sev[0])
+                try:
+                    base_f, cur_f = float(base), float(cur)
+                    worse = (cur_f - base_f) * sev[1]
+                    if abs(base_f) > 0 \
+                            and worse > abs(base_f) * WORSE_PCT:
+                        outcome = "worsened"
+                except (TypeError, ValueError):
+                    pass
+        act["outcome"] = outcome
+        _m_actions.inc(rule=rule, action=act["action"], outcome=outcome)
+        FLIGHT.record(
+            "policy", "outcome", rule=rule, action=act["action"],
+            outcome=outcome, cause_id=act.get("cause_id"),
+            action_id=act.get("id"),
+            detail=(f"re-sampled {self.verify_s:g}s after the action: "
+                    f"rule {outcome}"))
+        logger.info("policy: %s %s -> outcome %s", rule, act["action"],
+                    outcome)
+
+    # -- read side -------------------------------------------------------
+    def recent_actions(self, last: int = 8) -> List[Dict[str, Any]]:
+        """Newest-last action records (the `fiber-tpu top` feed rides
+        this through monitor_payload)."""
+        with self._lock:
+            out = [dict(a) for a in self._recent]
+        return out[-max(0, int(last)):]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "dry_run": self.dry_run,
+                "cooldown_s": self.cooldown_s,
+                "verify_s": self.verify_s,
+                "rules": (sorted(self._rules_filter)
+                          if self._rules_filter is not None else "all"),
+                "policies": [
+                    {"rule": p.rule, "action": p.action, "knob": p.knob,
+                     "cooldown_s": (self.cooldown_s
+                                    if p.cooldown_s is None
+                                    else p.cooldown_s)}
+                    for p in self._policies.values()],
+                "recent": [dict(a) for a in self._recent],
+                "actions_total": self.actions_total,
+                "suppressed_total": self.suppressed_total,
+                "pending_verifications": len(self._pending),
+            }
+
+    def reset(self) -> None:
+        """Revert every applied remediation and drop engine state (test
+        isolation: a leaked TX high-water or throttled weight must not
+        outlive the test that provoked it). ``WATCHDOG.clear()``
+        bypasses the clear-edge hooks, so this is the safety net."""
+        with self._lock:
+            applied = list(self._applied.values())
+            self._applied.clear()
+            self._pending.clear()
+            self._recent.clear()
+            self._last_action.clear()
+            self.actions_total = 0
+            self.suppressed_total = 0
+        for entry in applied:
+            try:
+                entry["revert"]()
+            except Exception:  # noqa: BLE001 - best-effort restore
+                logger.exception("policy: reset revert failed")
+
+
+#: Process-wide engine; configured by telemetry.refresh(), triggered by
+#: every AnomalyWatchdog instance's raise/clear edges.
+POLICY = PolicyEngine()

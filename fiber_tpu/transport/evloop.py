@@ -96,6 +96,19 @@ _IOV_MAX = 512
 TX_HIGH_WATER = 32 << 20
 
 
+def set_tx_high_water(n: int) -> int:
+    """Retune the TX high-water mark live (the policy plane's
+    tx_queue_high remediation halves it; the clear-edge revert restores
+    it). Floored at 1 MiB so a runaway tightening loop can never choke
+    enqueue to a standstill. Returns the previous value — senders read
+    the module global per enqueue, so the change takes effect on the
+    next frame."""
+    global TX_HIGH_WATER
+    prev = TX_HIGH_WATER
+    TX_HIGH_WATER = max(1 << 20, int(n))
+    return prev
+
+
 class EventLoop:
     """The per-process poller. Use :func:`get_loop`, not the class."""
 

@@ -57,6 +57,10 @@ DIRECTIONS: Dict[str, str] = {
     "cluster_device_mfu": "higher",
     "ici_repeat_wire_bytes": "lower",
     "ici_broadcast_wall_ratio": "higher",
+    # policy plane (bench-autonomy): lost tasks must stay at 0 and the
+    # on-but-idle engine overhead must not creep up
+    "autonomy_soak_lost_tasks": "lower",
+    "autonomy_gates": "special",
 }
 
 #: "special" metrics gate named RATIO FIELDS instead of "value"
@@ -70,6 +74,8 @@ RATIO_FIELDS: Dict[str, List[Tuple[str, str]]] = {
                              ("master_cpu_per_task_ratio", "lower")],
     "recovery_gates": [("ledger_overhead", "lower"),
                        ("resume_ratio", "lower")],
+    "autonomy_gates": [("idle_overhead", "lower"),
+                       ("chains_linked", "higher")],
 }
 
 

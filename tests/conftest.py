@@ -66,6 +66,19 @@ def pytest_configure(config):
 
 
 @pytest.fixture(autouse=True)
+def _policy_restore():
+    """The policy engine's remediations mutate process-wide knobs (TX
+    high-water, speculation quantiles, WDRR weights, compile-cache
+    pins). ``WATCHDOG.clear()`` bypasses the clear-edge reverts, so
+    every test ends with an explicit engine reset — a leaked
+    remediation must not outlive the test that provoked it."""
+    yield
+    from fiber_tpu.telemetry.policy import POLICY
+
+    POLICY.reset()
+
+
+@pytest.fixture(autouse=True)
 def leak_check():
     assert fiber_tpu.active_children() == [], "leaked processes from earlier test"
     yield

@@ -6,7 +6,7 @@ PYTEST ?= python -m pytest tests/ -q
 .PHONY: test stest test-all lint bench bench-store bench-telemetry \
 	bench-sched bench-transport bench-cluster bench-recovery \
 	bench-accounting bench-check bench-scale bench-ici \
-	bench-autonomy weakscale docs chaos
+	bench-autonomy bench-stream weakscale docs chaos
 
 # Tier 1: local backend (subprocess jobs)
 test:
@@ -28,7 +28,9 @@ test-all: test stest
 
 # Chaos tier (docs/robustness.md): the seeded fault-injection suite —
 # health-plane unit tests once, then the injection scenarios (including
-# the slow soaks) under three fixed seeds. The fast scenarios also run
+# the slow soaks) under fixed seeds, plus the streaming-data-plane
+# drills re-run under a fresh seed with a deliberately tiny default
+# admission window (docs/streaming.md). The fast scenarios also run
 # un-marked in tier 1; this target is the full deterministic sweep.
 chaos:
 	python -m pytest tests/test_health.py -q
@@ -40,6 +42,8 @@ chaos:
 	FIBER_CHAOS_SEED=505 FIBER_POLICY_VERIFY_S=0.2 \
 		FIBER_POLICY_COOLDOWN_S=0 \
 		python -m pytest tests/test_chaos.py -q
+	FIBER_CHAOS_SEED=606 FIBER_STREAM_WINDOW=4 \
+		python -m pytest tests/test_stream.py -q
 
 # FIBER_BENCH_ENFORCE: fail loudly when the 1 ms host-pool point
 # drifts past its budget (the driver's plain `python bench.py` only
@@ -118,6 +122,18 @@ bench-transport:
 bench-scale:
 	JAX_PLATFORMS=cpu python bench.py --scale --record > BENCH_scale.json; \
 	rc=$$?; cat BENCH_scale.json; exit $$rc
+
+# Streaming data plane gate (docs/streaming.md): a million tiny tasks
+# through a windowed imap_unordered over a generator — nothing
+# materialized anywhere. FAILS when the run completes < 1M tasks, when
+# master peak RSS grows > 1.5x across a 100x task-count increase
+# (retention must be O(stream_window)), or when streamed throughput
+# falls under 0.9x a materialized `map` of the same workload (best-of-2
+# subprocess arms — the window must keep the cluster fed). The record
+# lands in BENCH_stream.json either way.
+bench-stream:
+	JAX_PLATFORMS=cpu python bench.py --stream --record > BENCH_stream.json; \
+	rc=$$?; cat BENCH_stream.json; exit $$rc
 
 # Full-stack macro bench (docs/observability.md, ROADMAP item 5): the
 # whole stack at once — simulated multi-host pod, 8MB per-generation

@@ -1391,6 +1391,8 @@ def cmd_resume(args) -> int:
         header, completed, done = ledgermod.load(path)
     except (OSError, ValueError) as err:
         raise SystemExit(f"error: cannot load ledger: {err}") from None
+    if header.get("kind") == "stream":
+        return _resume_stream(args, path)
     spec_digest = header.get("spec")
     if not spec_digest:
         raise SystemExit(
@@ -1441,6 +1443,161 @@ def cmd_resume(args) -> int:
         summary["out"] = args.out
     print(json.dumps(summary))
     return 0
+
+
+def _stream_payload(digest: str):
+    """Fetch one journaled payload by digest: master disk tier first,
+    then the per-host caches via the backend (the replication hook
+    registers stream admits/results as precious)."""
+    from fiber_tpu import store as storemod
+
+    data = storemod.local_store().get_bytes(digest)
+    if data is None:
+        from fiber_tpu.backends import get_backend
+
+        fetch = getattr(get_backend(), "fetch_object", None)
+        if fetch is not None:
+            try:
+                data = fetch(digest)
+            except Exception:  # noqa: BLE001 - fall through to None
+                data = None
+    return data
+
+
+def _resume_stream(args, path: str) -> int:
+    """Resume a half-consumed STREAM ledger (docs/streaming.md):
+    restore every journaled result chunk by digest, re-execute
+    admitted-but-unjournaled chunks from their journaled input payloads
+    (the producer iterator died with the master — the admit records are
+    the only copy), journal the new results into the same ledger, and
+    emit the unconsumed suffix (everything past the journaled consumer
+    cursor) to ``--out``. Items the dead master never ADMITTED are
+    unrecoverable by construction; the summary reports the admitted
+    frontier rather than pretending to know the stream's full length."""
+    import fiber_tpu
+    from fiber_tpu import serialization
+    from fiber_tpu.store import ledger as ledgermod
+
+    try:
+        header, admits, completed, cursor, done = \
+            ledgermod.load_stream(path)
+    except (OSError, ValueError) as err:
+        raise SystemExit(
+            f"error: cannot load stream ledger: {err}") from None
+    spec_digest = header.get("spec")
+    if not spec_digest:
+        raise SystemExit(
+            "error: this stream ledger carries no resumable spec "
+            "payload; resume by re-calling Pool.imap(..., job_id=...) "
+            "from the original script")
+    data = _stream_payload(spec_digest)
+    if data is None:
+        raise SystemExit(
+            f"error: spec payload {str(spec_digest)[:12]} not found in "
+            "any store tier; resume from the original script instead")
+    try:
+        func_blob, star, chunksize = serialization.loads(data)
+        func = serialization.loads(func_blob)
+    except Exception as err:  # noqa: BLE001
+        raise SystemExit(
+            f"error: stream spec did not deserialize: {err}") from None
+    bases = sorted(admits)
+    n_admitted = sum(admits[b][0] for b in bases)
+    pending = [b for b in bases if b not in completed]
+    print(f"resume: stream job {args.job_id!r} — {n_admitted} admitted "
+          f"task(s) in {len(bases)} chunk(s), {len(completed)} result "
+          f"chunk(s) journaled, cursor at {cursor}"
+          + (" (ledger already complete)" if done else ""),
+          file=sys.stderr)
+    values_by_base = {}
+    restored_tasks = 0
+    for b in bases:
+        if b not in completed:
+            continue
+        n, digest = completed[b]
+        payload = _stream_payload(digest)
+        vals = None
+        if payload is not None:
+            try:
+                vals = serialization.loads(payload)
+            except Exception:  # noqa: BLE001 - corrupt == lost
+                vals = None
+        if isinstance(vals, list) and len(vals) == n:
+            values_by_base[b] = vals
+            restored_tasks += n
+        else:
+            # Result payload lost: degrade that chunk to re-execution
+            # from its admit payload (tasks are idempotent).
+            pending.append(b)
+    pending = sorted(set(pending))
+    pending_items = []
+    spans = []  # (base, start, n) slices into the re-executed batch
+    for b in pending:
+        n, digest = admits[b]
+        payload = _stream_payload(digest)
+        items = None
+        if payload is not None:
+            try:
+                items = serialization.loads(payload)
+            except Exception:  # noqa: BLE001
+                items = None
+        if not isinstance(items, list) or len(items) != n:
+            raise SystemExit(
+                f"error: admit payload for chunk base={b} not found in "
+                "any store tier; the stream cannot be resumed "
+                "losslessly")
+        spans.append((b, len(pending_items), n))
+        pending_items.extend(items)
+    executed_tasks = len(pending_items)
+    led = None
+    if pending_items:
+        store = storemod_local_for_ledger()
+        led = ledgermod.MapLedger(path, store)
+        led.adopt(completed)
+        led.adopt_admits(admits)
+        with fiber_tpu.Pool(args.processes or None) as pool:
+            if star:
+                out = pool.starmap(func, pending_items,
+                                   chunksize=chunksize)
+            else:
+                out = pool.map(func, pending_items, chunksize=chunksize)
+        for b, start, n in spans:
+            vals = out[start:start + n]
+            values_by_base[b] = vals
+            led.record_chunk(b, n, vals)
+    flat = []
+    for b in bases:
+        flat.extend(values_by_base[b])
+    if led is not None:
+        if not done:
+            led.record_done()
+        led.flush()
+        led.close()
+    summary = {
+        "job_id": args.job_id, "kind": "stream",
+        "tasks": n_admitted,
+        "restored_tasks": restored_tasks,
+        "executed_tasks": executed_tasks,
+        "restored_chunks": len(bases) - len(spans),
+        "chunks": len(bases),
+        "consumed": cursor,
+        "emitted": max(0, len(flat) - cursor),
+        "trace": header.get("trace"),
+    }
+    if args.out:
+        with open(args.out, "wb") as fh:
+            fh.write(serialization.dumps(flat[cursor:]))
+        summary["out"] = args.out
+    print(json.dumps(summary))
+    return 0
+
+
+def storemod_local_for_ledger():
+    """The store instance stream-resume journals through (factored so
+    tests can see exactly which tier the payloads land in)."""
+    from fiber_tpu import store as storemod
+
+    return storemod.local_store()
 
 
 def cmd_jobs(args) -> int:

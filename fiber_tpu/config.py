@@ -143,6 +143,25 @@ DEFAULTS: Dict[str, Any] = {
     # past it (safe: the host RAM/disk tiers still hold the bytes);
     # pinned entries are untouchable.
     "store_device_capacity_mb": 256,
+    # --- streaming data plane (docs/streaming.md) ---
+    # Windowed streaming admission for imap/imap_unordered: the master
+    # pulls from the caller's iterator lazily and keeps at most
+    # stream_window chunks encoded + in flight + un-yielded at any
+    # instant, so master memory is O(window) instead of O(n). A slow
+    # consumer parks admission (condition-variable), which parks
+    # dispatch, which lets transport credits drain — backpressure is
+    # end-to-end. Off, imap still avoids materializing the iterable but
+    # admission is unwindowed (legacy posture; the ledger path then
+    # needs a full materialization for its fixed task digest).
+    "stream_enabled": True,
+    # Admission window in CHUNKS (not tasks): encoded-but-unyielded
+    # chunks the master will hold at once. Also the policy plane's
+    # `queue_growth` -> shrink_stream_window knob target. 128 keeps
+    # streamed throughput within a few percent of a materialized map
+    # (each admission park/wake cycle briefly starves dispatch, so the
+    # window must cover several consumer batches); halve it per level
+    # of memory pressure instead of shrinking the default.
+    "stream_window": 128,
     # --- durability (docs/robustness.md "Durable maps") ---
     # Write-ahead map ledger: Pool.map(..., job_id=...) journals the
     # task spec + every completed chunk's result digest under

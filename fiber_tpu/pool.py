@@ -74,6 +74,12 @@ _g_queue_depth = telemetry.gauge(
     "pool_queue_depth", "Chunks queued for dispatch")
 _g_inflight = telemetry.gauge(
     "pool_inflight_tasks", "Task items submitted but not yet completed")
+_m_stream_admit_waits = telemetry.counter(
+    "pool_stream_admit_waits",
+    "Stream admission park episodes (consumer slower than producer)")
+_g_stream_window_fill = telemetry.gauge(
+    "pool_stream_window_fill",
+    "Admitted-but-unyielded task items across active streams")
 
 DEFAULT_CHUNKSIZE = 32
 MAX_INFLIGHT_TASKS = 20000
@@ -87,6 +93,11 @@ _DEVICE_BCAST_MIN = 64 << 10
 _MAP_IDS = itertools.count(1)
 
 _UNSET = object()
+#: A result slot whose value has been handed to the consumer. The slot
+#: stays occupied (duplicate fills from speculation losers / death
+#: resubmits still dedup against it) but the payload reference is gone —
+#: the sliding-window release that keeps a streaming master O(window).
+_YIELDED = object()
 
 #: Consecutive failed worker starts (with zero live workers and pending
 #: work) before the pool gives up and fails the pending maps.
@@ -119,32 +130,130 @@ class RemoteError(Exception):
 
 
 class _Entry:
-    __slots__ = ("values", "remaining", "total", "callbacks", "yielded")
+    __slots__ = ("values", "remaining", "total", "callbacks", "yielded",
+                 "stream", "finalized", "bits", "pending")
 
-    def __init__(self, n: int) -> None:
-        self.values: List[Any] = [_UNSET] * n
+    def __init__(self, n: int, stream: bool = False) -> None:
+        #: Classic entries hold a full slot list (the caller asked for
+        #: every result at once). Stream entries instead keep a dedup
+        #: BITMAP (1 bit per admitted slot) plus a dict of
+        #: filled-but-unyielded values: live payloads stay
+        #: O(stream_window) and per-task bookkeeping is ~0.125 bytes —
+        #: a million-task stream costs the master ~128KB, not an
+        #: O(n) pointer list. That IS the constant-memory claim the
+        #: `make bench-stream` RSS gate enforces.
+        self.values: List[Any] = [] if stream else [_UNSET] * n
+        self.bits: Optional[bytearray] = bytearray() if stream else None
+        self.pending: Optional[Dict[int, Any]] = {} if stream else None
         self.remaining = n
         self.total = n
         self.callbacks: List[Callable] = []
         self.yielded = 0
+        #: Stream entries grow via extend() and complete only once the
+        #: admission loop finalizes them — remaining == 0 alone means
+        #: "caught up", not "done".
+        self.stream = stream
+        self.finalized = not stream
+
+    def done_locked(self) -> bool:
+        return self.remaining == 0 and self.finalized
+
+    def filled_locked(self, idx: int) -> bool:
+        """Has slot ``idx`` ever filled (yielded or still pending)?"""
+        if self.stream:
+            return bool((self.bits[idx >> 3] >> (idx & 7)) & 1)
+        return self.values[idx] is not _UNSET
 
 
 class ResultStore:
     """Sequence-keyed store of in-flight map results with ordered and
-    unordered iteration."""
+    unordered iteration.
+
+    Two entry shapes share the bookkeeping: classic map entries are born
+    with their full slot count, and *stream* entries (``add_stream``)
+    start empty and grow chunk-by-chunk via ``extend`` as the admission
+    loop pulls from the caller's iterator — completion requires both
+    ``remaining == 0`` and ``finalize()``. Stream iteration releases
+    each yielded slot's payload reference immediately (``_YIELDED``
+    tombstone), so the store holds O(un-yielded window) payloads, never
+    O(stream length); duplicate fills still dedup against tombstones."""
 
     def __init__(self) -> None:
         self._entries: Dict[int, _Entry] = {}
         self._seq = itertools.count()
         self._cond = threading.Condition()
-        self._completion_log: Dict[int, List[int]] = {}
+        self._completion_log: Dict[int, deque] = {}
 
     def add(self, n: int) -> int:
         seq = next(self._seq)
         with self._cond:
             self._entries[seq] = _Entry(n)
-            self._completion_log[seq] = []
+            self._completion_log[seq] = deque()
         return seq
+
+    def add_stream(self) -> int:
+        """Open a growable stream entry (zero slots until ``extend``)."""
+        seq = next(self._seq)
+        with self._cond:
+            self._entries[seq] = _Entry(0, stream=True)
+            self._completion_log[seq] = deque()
+        return seq
+
+    def extend(self, seq: int, n: int) -> int:
+        """Grow a stream entry by ``n`` slots; returns the base index of
+        the new chunk. Raising the outstanding count needs no notify —
+        only downward transitions matter to any waiter's predicate."""
+        with self._cond:
+            entry = self._entries[seq]
+            if not entry.stream or entry.finalized:
+                raise ValueError("extend() on a non-stream or finalized seq")
+            base = entry.total
+            entry.total += n
+            entry.remaining += n
+            need = (entry.total + 7) >> 3
+            if len(entry.bits) < need:
+                entry.bits.extend(b"\x00" * (need - len(entry.bits)))
+        return base
+
+    def finalize(self, seq: int) -> None:
+        """The admission loop exhausted the source iterator: no more
+        slots will be added. Completion callbacks fire once every
+        admitted slot has also filled."""
+        callbacks: List[Callable] = []
+        with self._cond:
+            entry = self._entries.get(seq)
+            if entry is None or entry.finalized:
+                return
+            entry.finalized = True
+            if entry.remaining == 0:
+                callbacks = list(entry.callbacks)
+            self._cond.notify_all()
+        self._drain_callbacks(callbacks)
+
+    def stream_fill_state(self, seq: int) -> Tuple[int, int, bool]:
+        """(admitted_total, yielded, finalized) for window accounting."""
+        with self._cond:
+            entry = self._entries.get(seq)
+            if entry is None:
+                return (0, 0, True)
+            return (entry.total, entry.yielded, entry.finalized)
+
+    def wait_stream_capacity(self, seq: int, max_unyielded: int,
+                             timeout: Optional[float] = None) -> bool:
+        """Park the admission loop until the consumer has drained the
+        window: un-yielded slots (admitted − yielded) <= ``max_unyielded``.
+        Rides the store condition — every fill/fail/yield notifies — so
+        a slow consumer parks admission with zero busy-wait, which parks
+        dispatch, which lets transport credits drain (the end-to-end
+        backpressure chain, docs/streaming.md). True when capacity is
+        available (or the entry is gone/failed — the caller re-checks)."""
+        def _have_room() -> bool:
+            entry = self._entries.get(seq)
+            if entry is None or entry.done_locked():
+                return True
+            return (entry.total - entry.yielded) <= max_unyielded
+        with self._cond:
+            return self._cond.wait_for(_have_room, timeout)
 
     def fill(self, seq: int, base: int, values: List[Any]) -> int:
         """Fill result slots; duplicates (speculation losers, death
@@ -162,14 +271,26 @@ class ResultStore:
                     f"result frame out of range: base={base} "
                     f"n={len(values)} total={entry.total}"
                 )
-            for offset, value in enumerate(values):
-                idx = base + offset
-                if entry.values[idx] is _UNSET:
-                    entry.values[idx] = value
-                    entry.remaining -= 1
-                    newly += 1
-                    self._completion_log[seq].append(idx)
-            callbacks = list(entry.callbacks) if entry.remaining == 0 else []
+            if entry.stream:
+                bits = entry.bits
+                for offset, value in enumerate(values):
+                    idx = base + offset
+                    if not (bits[idx >> 3] >> (idx & 7)) & 1:
+                        bits[idx >> 3] |= 1 << (idx & 7)
+                        entry.pending[idx] = value
+                        entry.remaining -= 1
+                        newly += 1
+                        self._completion_log[seq].append(idx)
+            else:
+                for offset, value in enumerate(values):
+                    idx = base + offset
+                    if entry.values[idx] is _UNSET:
+                        entry.values[idx] = value
+                        entry.remaining -= 1
+                        newly += 1
+                        self._completion_log[seq].append(idx)
+            callbacks = (list(entry.callbacks)
+                         if entry.done_locked() else [])
             self._cond.notify_all()
         for cb in callbacks:
             try:
@@ -181,12 +302,12 @@ class ResultStore:
     def ready(self, seq: int) -> bool:
         with self._cond:
             entry = self._entries[seq]
-            return entry.remaining == 0
+            return entry.done_locked()
 
     def wait(self, seq: int, timeout: Optional[float] = None) -> List[Any]:
         with self._cond:
             ok = self._cond.wait_for(
-                lambda: self._entries[seq].remaining == 0, timeout
+                lambda: self._entries[seq].done_locked(), timeout
             )
             if not ok:
                 raise TimeoutError("pool result wait timed out")
@@ -200,7 +321,7 @@ class ResultStore:
     def add_callback(self, seq: int, cb: Callable) -> None:
         with self._cond:
             entry = self._entries.get(seq)
-            if entry is None or entry.remaining == 0:
+            if entry is None or entry.done_locked():
                 fire = True
             else:
                 entry.callbacks.append(cb)
@@ -209,54 +330,145 @@ class ResultStore:
             cb()
 
     def iter_ordered(self, seq: int):
-        """Yield results in submission order as they become available."""
+        """Yield results in submission order as they become available.
+        Each yielded slot's payload reference is dropped at grab time
+        (stream: popped from the pending dict; classic: ``_YIELDED``
+        tombstone) and the store condition notified, which is what
+        advances a stream's admission window as the ordered head moves.
+        The whole contiguous ready run is grabbed under ONE lock
+        acquire — per-item lock+notify is measurable at 1M tasks — and
+        the local batch is bounded by the un-yielded window, so memory
+        stays O(window)."""
         i = 0
         while True:
+            batch: List[Any] = []
             with self._cond:
                 entry = self._entries.get(seq)
                 if entry is None:
                     return
-                if i >= entry.total:
+                if i >= entry.total and entry.finalized:
                     self._pop(seq)
                     return
-                self._cond.wait_for(
-                    lambda: self._entries[seq].values[i] is not _UNSET
-                )
-                value = self._entries[seq].values[i]
-            yield value
-            i += 1
+
+                def _head_ready() -> bool:
+                    e = self._entries.get(seq)
+                    if e is None:
+                        return True
+                    if i < e.total:
+                        return e.filled_locked(i) and (
+                            not e.stream or i in e.pending)
+                    return e.finalized  # stream: past the admitted tail
+                self._cond.wait_for(_head_ready)
+                entry = self._entries.get(seq)
+                if entry is None:
+                    return
+                if i >= entry.total:  # finalized with no more slots
+                    self._pop(seq)
+                    return
+                if entry.stream:
+                    pending = entry.pending
+                    while i < entry.total and i in pending:
+                        batch.append(pending.pop(i))
+                        entry.yielded += 1
+                        i += 1
+                else:
+                    vals = entry.values
+                    while i < entry.total and vals[i] is not _UNSET:
+                        batch.append(vals[i])
+                        vals[i] = _YIELDED
+                        entry.yielded += 1
+                        i += 1
+                if batch:
+                    self._cond.notify_all()
+            for value in batch:
+                yield value
 
     def iter_unordered(self, seq: int):
-        """Yield results in completion order."""
-        yielded = 0
+        """Yield results in completion order. The completion log is a
+        deque consumed by popleft, so it too stays O(un-yielded window)
+        on a stream; yielded slots release their payload reference at
+        grab time like iter_ordered, and the log is drained in one
+        batch per lock acquire."""
         while True:
+            batch: List[Any] = []
             with self._cond:
                 entry = self._entries.get(seq)
                 if entry is None:
                     return
-                if yielded >= entry.total:
+                log = self._completion_log.get(seq)
+                if not log and entry.yielded >= entry.total \
+                        and entry.finalized:
                     self._pop(seq)
                     return
-                log = self._completion_log[seq]
-                self._cond.wait_for(
-                    lambda: len(self._completion_log[seq]) > yielded
-                )
-                idx = log[yielded]
-                value = entry.values[idx]
-            yield value
-            yielded += 1
+
+                def _have_result() -> bool:
+                    e = self._entries.get(seq)
+                    if e is None:
+                        return True
+                    lg = self._completion_log.get(seq)
+                    return bool(lg) or (e.finalized
+                                        and e.yielded >= e.total)
+                self._cond.wait_for(_have_result)
+                entry = self._entries.get(seq)
+                log = self._completion_log.get(seq)
+                if entry is None:
+                    return
+                if not log:  # finalized, everything already yielded
+                    self._pop(seq)
+                    return
+                if entry.stream:
+                    # Detach the whole log under an O(1) lock hold and
+                    # pop the values OUTSIDE the lock: fill() only ever
+                    # ADDS distinct keys (dedup rides the bitmap, not
+                    # the dict), so per-key dict ops need no lock, and
+                    # the result loop's fills never stall behind a
+                    # windowful of consumer pops.
+                    detached = log
+                    self._completion_log[seq] = deque()
+                    entry.yielded += len(log)
+                    self._cond.notify_all()
+                    pending = entry.pending
+                else:
+                    detached = None
+                    vals = entry.values
+                    while log:
+                        idx = log.popleft()
+                        batch.append(vals[idx])
+                        vals[idx] = _YIELDED
+                        entry.yielded += 1
+                    self._cond.notify_all()
+            if detached is not None:
+                batch = [pending.pop(idx) for idx in detached]
+            for value in batch:
+                yield value
 
     def _fail_entry_locked(self, seq: int, entry: "_Entry",
                            exc: BaseException, reason: str,
                            direct: bool) -> List[Callable]:
         """Fail an entry's unset slots (caller holds the lock); returns
         the completion callbacks to fire outside the lock."""
-        log = self._completion_log.get(seq, [])
-        for i, v in enumerate(entry.values):
-            if v is _UNSET:
-                entry.values[i] = _Failure(exc, reason, direct=direct)
-                log.append(i)  # unblock iter_unordered consumers too
-        if entry.remaining > 0:
+        log = self._completion_log.get(seq)
+        if log is None:
+            log = self._completion_log[seq] = deque()
+        if entry.stream:
+            bits = entry.bits
+            for i in range(entry.total):
+                if not (bits[i >> 3] >> (i & 7)) & 1:
+                    bits[i >> 3] |= 1 << (i & 7)
+                    entry.pending[i] = _Failure(exc, reason,
+                                                direct=direct)
+                    log.append(i)  # unblock iter_unordered too
+        else:
+            for i, v in enumerate(entry.values):
+                if v is _UNSET:
+                    entry.values[i] = _Failure(exc, reason, direct=direct)
+                    log.append(i)  # unblock iter_unordered consumers too
+        # A failed stream admits nothing more: finalize it here so
+        # iterators terminate after draining the failure markers and the
+        # admission loop's capacity wait falls through.
+        fresh_fail = entry.remaining > 0 or not entry.finalized
+        entry.finalized = True
+        if fresh_fail:
             entry.remaining = 0
             # Completion callbacks must fire on failure paths too, or
             # map_async consumers waiting on a callback (rather than
@@ -285,9 +497,18 @@ class ResultStore:
             self._cond.notify_all()
         self._drain_callbacks(callbacks)
 
+    def _outstanding_locked(self) -> int:
+        """Unfilled slots, plus one phantom unit per open (unfinalized)
+        stream — a caught-up stream between admissions must still hold
+        ``join()``/drain gates open, or the pool would release workers
+        mid-stream. The phantom is noise to the 20k-item inflight gate."""
+        return (sum(e.remaining for e in self._entries.values())
+                + sum(1 for e in self._entries.values()
+                      if e.stream and not e.finalized))
+
     def outstanding(self) -> int:
         with self._cond:
-            return sum(e.remaining for e in self._entries.values())
+            return self._outstanding_locked()
 
     def wait_outstanding_below(self, limit: int,
                                timeout: Optional[float] = None) -> bool:
@@ -299,18 +520,18 @@ class ResultStore:
         strand a waiter on a stale True."""
         with self._cond:
             return self._cond.wait_for(
-                lambda: sum(e.remaining
-                            for e in self._entries.values()) <= limit,
+                lambda: self._outstanding_locked() <= limit,
                 timeout,
             )
 
     def is_done(self, seq: int) -> bool:
         """True when ``seq`` has completed or failed — its chunks are
         dead weight and must not be handed to (or resubmitted at)
-        workers."""
+        workers. A caught-up but unfinalized stream is NOT done: more
+        chunks are coming."""
         with self._cond:
             entry = self._entries.get(seq)
-            return entry is None or entry.remaining == 0
+            return entry is None or entry.done_locked()
 
     def abort_all(self, exc: BaseException,
                   reason: str = "pool terminated",
@@ -1291,6 +1512,21 @@ class Pool:
         self._ledger_local = None   # fallback LocalStore when _objstore off
         self._ledger_last: Dict[str, Any] = {}
         self._n_restored = 0
+        #: Streaming data plane (docs/streaming.md): seq -> live
+        #: admission window in chunks (the policy plane's
+        #: shrink_stream_window knob mutates it mid-stream), seq ->
+        #: pre-shrink window for the owned revert, (seq, base) ->
+        #: (raw chunk items, store digests) — the storemiss-resend
+        #: source once the producer iterator has moved past the chunk,
+        #: released as each chunk fills so it stays O(window). Stream
+        #: seqs in _stream_lazy defer oversized-result resolution to
+        #: yield time, so spilled results park in the store's tiers
+        #: instead of master RAM.
+        self._stream_windows: Dict[int, int] = {}
+        self._stream_window_orig: Dict[int, int] = {}
+        self._stream_ctx: Dict[Tuple[int, int], Tuple] = {}
+        self._stream_lazy: set = set()
+        self._stream_admit_waits = 0
 
         self._store = ResultStore()
         # Scheduler plane (fiber_tpu/sched, docs/scheduling.md): the
@@ -1778,7 +2014,9 @@ class Pool:
                     self._bill_frame(entries[0][0] if entries else None,
                                      rx=len(data))
                     for seq, base, values in entries:
-                        if any(isinstance(v, ObjectRef) for v in values):
+                        if (seq not in self._stream_lazy
+                                and any(isinstance(v, ObjectRef)
+                                        for v in values)):
                             with global_timer.section(
                                     "pool.store_resolve"):
                                 values = self._resolve_result_refs(
@@ -1793,6 +2031,8 @@ class Pool:
                         newly = self._store.fill(seq, base, values)
                         if newly and bill_key is not None:
                             COSTS.charge(bill_key, tasks=newly)
+                        if self._stream_windows:
+                            self._release_stream_chunk(seq, base)
                     _g_inflight.set(self._store.outstanding())
                     continue
                 if msg[0] != "result":
@@ -1805,7 +2045,14 @@ class Pool:
                     # read as death.
                     detector.beat(ident)
                 self._bill_frame(seq, rx=len(data))
-                if any(isinstance(v, ObjectRef) for v in values):
+                if (seq not in self._stream_lazy
+                        and any(isinstance(v, ObjectRef)
+                                for v in values)):
+                    # Stream seqs without a journal skip the eager
+                    # resolve: the refs stay in the store's RAM/disk
+                    # tiers (which spill under pressure) and resolve at
+                    # YIELD time — incremental result spill, master RAM
+                    # stays O(window) even with oversized results.
                     with global_timer.section("pool.store_resolve"):
                         values = self._resolve_result_refs(values)
                 self._n_completed += len(values)
@@ -1829,6 +2076,11 @@ class Pool:
                     # death/storemiss resubmit fills nothing new and
                     # bills nothing.
                     COSTS.charge(bill_key, tasks=newly)
+                if self._stream_windows:
+                    # A filled stream chunk's raw-items context (and its
+                    # encoded-arg store refs) are dead weight: release
+                    # now, not at stream end — O(window) master state.
+                    self._release_stream_chunk(seq, base)
                 _g_inflight.set(self._store.outstanding())
             except Exception:
                 logger.exception("pool: dropping malformed result frame")
@@ -1955,7 +2207,18 @@ class Pool:
         if ctx is None or self._store.is_done(seq):
             return
         fdigest, blob, star, items, tctx, bkey = ctx
-        chunk = items[base:base + n]
+        if items is None:
+            # Stream: the source iterator moved on long ago; the
+            # per-chunk context table holds the only raw-items copy
+            # (released when the chunk fills — a filled chunk never
+            # storemisses meaningfully, dedup drops the resend).
+            with self._seq_ctx_lock:
+                sctx = self._stream_ctx.get((seq, base))
+            if sctx is None:
+                return
+            chunk = sctx[0][:n]
+        else:
+            chunk = items[base:base + n]
         # Same trace context (and billing key) as the original handout:
         # the inline resend is one more hop of the same logical task,
         # not a new trace — and its duplicate wire bytes bill to the
@@ -2357,6 +2620,8 @@ class Pool:
             "tasks_restored": self._n_restored,
             "chunks_resubmitted": self._n_resubmitted,
             "store_fallbacks": self._store_fallbacks,
+            "stream_admit_waits": self._stream_admit_waits,
+            "streams_active": len(self._stream_windows),
             "queue_depth": self._taskq.qsize(),
             "outstanding": self._store.outstanding(),
             "workers": len(self._workers),
@@ -2382,6 +2647,12 @@ class Pool:
         (the monitor sampler's per-tick probe; also run by metrics())."""
         _g_queue_depth.set(self._taskq.qsize())
         _g_inflight.set(self._store.outstanding())
+        if self._stream_windows:
+            fill = 0
+            for seq in list(self._stream_windows):
+                total, yielded, _fin = self._store.stream_fill_state(seq)
+                fill += max(0, total - yielded)
+            _g_stream_window_fill.set(fill)
 
     def timeseries(self) -> Dict[str, Any]:
         """This process's continuous-monitor surface: the sampled
@@ -2719,6 +2990,468 @@ class Pool:
                 pass
         return result
 
+    # -- streaming data plane (docs/streaming.md) --------------------------
+    def _submit_stream(self, func: Callable, iterable: Iterable[Any],
+                       chunksize: Optional[int], star: bool,
+                       priority: float = 1.0,
+                       job_id: Optional[str] = None,
+                       budget: Optional[CostBudget] = None,
+                       windowed: bool = True,
+                       ordered: bool = True):
+        """Open a streaming map: a background admission loop pulls from
+        the caller's iterator lazily, keeping at most ``stream_window``
+        chunks encoded + in flight + un-yielded at any instant, so the
+        master never materializes the task list. Returns
+        ``(seq, ledger, chunksize)`` for the imap variants to build
+        their consumer iterator around."""
+        from fiber_tpu import config as _config
+
+        if self._closed or self._terminated:
+            raise ValueError("Pool not running")
+        self._ensure_workers(func)
+        cfg = _config.get()
+        it = iter(iterable)
+        seq = self._store.add_stream()
+        mid = next(_MAP_IDS)
+        bill_key = (COSTS.tenant,
+                    job_id if job_id is not None else f"map-{mid}",
+                    f"m{mid}")
+        if COSTS.enabled:
+            self._seq_bill[seq] = bill_key
+            self._map_wall0[seq] = time.perf_counter()
+            if budget is not None:
+                COSTS.set_budget(bill_key, budget)
+                self._map_budgets[bill_key] = budget
+                while len(self._map_budgets) > 64:
+                    self._map_budgets.pop(next(iter(self._map_budgets)))
+        elif budget is not None:
+            logger.warning("accounting disabled; budget for job %r is "
+                           "not enforced", job_id)
+        # No length to divide: the streaming default is the chunk cap
+        # itself (a short stream just produces few chunks).
+        chunksize = max(1, int(chunksize if chunksize is not None
+                               else DEFAULT_CHUNKSIZE))
+        trace_id = telemetry.maybe_start_trace()
+        # Stream journal (docs/streaming.md "Stream ledger"): admits
+        # (input payloads, resumable without the producer), result
+        # chunks, and the consumer's cursor — `fiber-tpu resume` works
+        # on a half-consumed stream from these alone.
+        ledger = None
+        completed: Dict[int, Tuple[int, str]] = {}
+        if job_id is not None:
+            try:
+                ledger, completed, chunksize, trace_id = \
+                    self._stream_ledger_open(job_id, func, chunksize,
+                                             star, trace_id)
+            except ValueError:
+                self._store.fail(seq, RuntimeError("ledger rejected"),
+                                 reason="ledger spec mismatch")
+                raise
+            except Exception:  # noqa: BLE001 - durability best-effort
+                logger.warning(
+                    "ledger: journaling disabled for stream job %r "
+                    "(open failed); the stream runs but is not "
+                    "resumable", job_id, exc_info=True)
+                ledger, completed = None, {}
+        window = (max(1, int(cfg.stream_window)) if windowed
+                  else 1 << 30)
+        self._stream_windows[seq] = window
+        if ledger is None:
+            # Without a journal the master never needs result VALUES on
+            # the hot loop: oversized results stay ObjectRefs in the
+            # store's spillable tiers and resolve at yield time.
+            self._stream_lazy.add(seq)
+        self._sched.register_map(seq, priority)
+        if windowed:
+            # Window-aware handout: a hier sub-master's range must not
+            # swallow the whole admission window — other hosts would
+            # starve inside it.
+            self._sched.note_stream(seq, max(1, window // 4))
+        self._store.add_callback(
+            seq, lambda: self._sched.release_map(seq))
+        self._store.add_callback(
+            seq, lambda: self._stream_cleanup(seq))
+        if ledger is not None:
+            self._ledgers[seq] = ledger
+            self._store.add_callback(seq,
+                                     lambda: self._ledger_done(seq))
+        if COSTS.enabled:
+            self._store.add_callback(
+                seq, lambda: self._finish_billing(seq, job_id, ledger,
+                                                  budget))
+        blob = serialization.dumps(func)
+        fdigest = hashlib.md5(blob).digest()
+        env_key = bill_key if COSTS.enabled else None
+        if trace_id:
+            with tracing.span("pool.stream_open", trace=trace_id,
+                              seq=seq) as sp:
+                tctx = (trace_id, sp["span"])
+        else:
+            tctx = None
+        # Storemiss context for streams: items=None marks "per-chunk,
+        # see _stream_ctx" (the iterator can't be replayed).
+        with self._seq_ctx_lock:
+            self._seq_ctx[seq] = (fdigest, blob, star, None, tctx,
+                                  env_key)
+        self._store.add_callback(
+            seq, lambda: self._seq_ctx.pop(seq, None))
+        FLIGHT.record("pool", "stream", seq=seq, event="open",
+                      window=window if windowed else None,
+                      chunksize=chunksize, trace=trace_id, job=job_id,
+                      restored_chunks=len(completed) or None)
+        threading.Thread(
+            target=self._stream_admit,
+            args=(seq, it, fdigest, blob, star, chunksize, tctx,
+                  env_key, ledger, completed, job_id),
+            name=f"fiber-stream-admit-{seq}", daemon=True,
+        ).start()
+        return seq, ledger, chunksize
+
+    def _stream_admit(self, seq, it, fdigest, blob, star, chunksize,
+                      tctx, env_key, ledger, completed, job_id) -> None:
+        """The windowed admission loop (one daemon thread per stream):
+        pull one chunk from the producer, park while the window is full
+        (condition-variable on the ResultStore — the same no-busy-wait
+        posture as ``_task_loop``'s inflight gate), encode, journal the
+        admit, dispatch. Exhaustion finalizes the stream entry."""
+        from fiber_tpu.store.replicate import REPLICATOR
+
+        admitted_chunks = 0
+        restored_tasks = 0
+        restored_chunks = 0
+        try:
+            while True:
+                if self._terminated or self._store.is_done(seq):
+                    return  # aborted/failed mid-stream; no finalize
+                window = self._stream_windows.get(seq, 1)
+                # "At most `window` chunks un-yielded at any instant":
+                # admitting the next chunk is legal once the backlog is
+                # a chunk short of the window.
+                limit = max(0, window - 1) * chunksize
+                waited_t0 = None
+                # First probe is non-blocking so even a sub-tick park
+                # registers as an episode (the gauge the slow-consumer
+                # drills read); subsequent waits ride the condition
+                # with a bounded tick, _task_loop posture.
+                while not self._store.wait_stream_capacity(
+                        seq, limit,
+                        timeout=(0.0 if waited_t0 is None else 0.5)):
+                    if waited_t0 is None:
+                        waited_t0 = time.perf_counter()
+                        self._stream_admit_waits += 1
+                        _m_stream_admit_waits.inc()
+                    if self._terminated:
+                        return
+                    if self._closed:
+                        break
+                    # Re-read per wait tick: a policy-plane
+                    # shrink/restore takes effect mid-park.
+                    window = self._stream_windows.get(seq, window)
+                    limit = max(0, window - 1) * chunksize
+                if waited_t0 is not None and FLIGHT.enabled:
+                    FLIGHT.record(
+                        "pool", "stream", seq=seq, event="admit_wait",
+                        wait_s=round(time.perf_counter() - waited_t0, 4),
+                        reason="window full; consumer slower than "
+                               "producer — admission parked")
+                if self._closed:
+                    # close() mid-admission is producer EOF: the
+                    # consumer abandoned the iterator (or the operator
+                    # is shutting down). Truncate here — join()'s drain
+                    # must see a finalized entry, not an admission loop
+                    # parked forever on capacity no consumer will free.
+                    logger.warning(
+                        "stream: pool closed with stream seq=%d still "
+                        "admitting; truncating after %d chunk(s)", seq,
+                        admitted_chunks)
+                    break
+                if self._store.is_done(seq):
+                    return
+                # Admit a BURST: every chunk the current window has
+                # room for rides one capacity check (one lock acquire,
+                # one park/wake cycle per windowful instead of per
+                # chunk — measurable at 1M tasks). The burst respects
+                # the same invariant as chunk-at-a-time admission:
+                # un-yielded slots never exceed window * chunksize.
+                total, yielded, _fin = self._store.stream_fill_state(seq)
+                room = limit - max(0, total - yielded)
+                burst = max(1, room // chunksize + 1)
+                exhausted = False
+                for _ in range(burst):
+                    chunk = list(itertools.islice(it, chunksize))
+                    if not chunk:
+                        exhausted = True  # producer done
+                        break
+                    base = self._store.extend(seq, len(chunk))
+                    admitted_chunks += 1
+                    self._n_submitted += len(chunk)
+                    _m_tasks_submitted.inc(len(chunk))
+                    rec = completed.get(base) if completed else None
+                    if rec is not None and rec[0] == len(chunk):
+                        values = self._ledger_restore(rec[1], rec[0])
+                        if values is not None:
+                            # Journaled on a previous run: fill
+                            # directly, never re-execute (exactly-once
+                            # across crashes; billed as
+                            # tasks_restored).
+                            self._store.fill(seq, base, values)
+                            self._n_restored += len(values)
+                            restored_tasks += len(values)
+                            restored_chunks += 1
+                            if env_key is not None:
+                                COSTS.charge(env_key,
+                                             tasks_restored=len(values))
+                            continue
+                    ser_t0 = time.perf_counter()
+                    enc_chunk = chunk
+                    chunk_digs: List[str] = []
+                    if (self._objstore is not None
+                            and self._store_inline_max):
+                        try:
+                            with global_timer.section(
+                                    "pool.store_encode"):
+                                enc_chunk = self._encode_items(
+                                    chunk, chunk_digs, env_key)
+                        except Exception:  # noqa: BLE001 - optimization
+                            logger.warning("store: stream arg encoding "
+                                           "failed; shipping inline",
+                                           exc_info=True)
+                            enc_chunk = chunk
+                            chunk_digs = []
+                    if chunk_digs:
+                        REPLICATOR.note(chunk_digs)
+                        self._sched.note_host_has(local_host_key(),
+                                                  chunk_digs)
+                    with self._seq_ctx_lock:
+                        self._stream_ctx[(seq, base)] = (chunk,
+                                                         tuple(chunk_digs))
+                    if ledger is not None:
+                        # Admit record BEFORE dispatch (write-ahead):
+                        # the input payload persists so `fiber-tpu
+                        # resume` can re-execute this chunk without
+                        # the producer.
+                        ledger.record_admit(base, len(chunk), chunk)
+                    digs = _chunk_digests(enc_chunk)
+                    if digs:
+                        self._sched.register_chunk((seq, base), digs)
+                    payload = serialization.dumps(
+                        ("task", seq, base, fdigest, blob, enc_chunk,
+                         star, tctx, env_key))
+                    if env_key is not None:
+                        COSTS.charge(env_key, serialize_s=(
+                            time.perf_counter() - ser_t0))
+                    self._taskq.put((payload, (seq, base)))
+                    if self._resilient and getattr(self, "_parked_count",
+                                                   0):
+                        try:
+                            self._task_ep.wake()
+                        except (TransportClosed, OSError):
+                            pass
+                _g_queue_depth.set(self._taskq.qsize())
+                total, yielded, _fin = self._store.stream_fill_state(seq)
+                _g_stream_window_fill.set(max(0, total - yielded))
+                if exhausted:
+                    break  # producer exhausted
+        except Exception as err:  # noqa: BLE001 - producer raised
+            logger.exception("stream: producer/admission failed for "
+                             "seq=%d", seq)
+            self._store.fail(seq, err, reason="stream producer raised",
+                             direct=True)
+            return
+        if ledger is not None:
+            self._ledger_last = {
+                "job_id": job_id, "seq": seq, "stream": True,
+                "chunks": admitted_chunks,
+                "restored_chunks": restored_chunks,
+                "pending_chunks": admitted_chunks - restored_chunks,
+                "restored_tasks": restored_tasks,
+            }
+        FLIGHT.record("pool", "stream", seq=seq, event="finalize",
+                      chunks=admitted_chunks,
+                      restored_chunks=restored_chunks or None)
+        self._store.finalize(seq)
+
+    def _stream_ledger_open(self, job_id: str, func: Callable,
+                            chunksize: int, star: bool,
+                            trace_id: Optional[str]):
+        """Open (or resume) a STREAM journal: ``kind="stream"`` header
+        keyed by a length-free task digest (the item count is unknowable
+        up front), admit records carrying the input payloads, result
+        chunks, and the consumer cursor. Returns
+        ``(ledger|None, completed, chunksize, trace_id)``."""
+        from fiber_tpu import config as _config
+        from fiber_tpu.store import ledger as ledgermod
+        from fiber_tpu.store.replicate import REPLICATOR
+
+        cfg = _config.get()
+        if not bool(cfg.ledger_enabled):
+            return None, {}, chunksize, trace_id
+        path = ledgermod.job_path(job_id)
+        tdigest = ledgermod.stream_task_digest(func, star)
+        store = self._ledger_store()
+        fsync_s = float(cfg.ledger_fsync_s)
+
+        def note_chunk(digest: str) -> None:
+            REPLICATOR.note((digest,))
+
+        completed: Dict[int, Tuple[int, str]] = {}
+        admits: Dict[int, Tuple[int, str]] = {}
+        header = None
+        if os.path.exists(path):
+            try:
+                header, admits, completed, _cursor, _done = \
+                    ledgermod.load_stream(path)
+            except ValueError:
+                logger.warning("ledger: %s has no readable header; "
+                               "starting stream job %r fresh", path,
+                               job_id)
+                header = None
+        if header is not None:
+            if header.get("kind") != "stream":
+                raise ValueError(
+                    f"job_id {job_id!r} was journaled as a classic map, "
+                    "not a stream; pick a new job_id, or resume it via "
+                    "map(..., job_id=)")
+            if header.get("task_digest") != tdigest:
+                raise ValueError(
+                    f"stream job_id {job_id!r} was journaled by a "
+                    "different task spec (function / call shape "
+                    f"changed); pick a new job_id or delete {path}")
+            # Recorded chunking wins: admit/result bases only line up
+            # against the journal under the original chunk size.
+            chunksize = int(header.get("chunksize") or chunksize)
+            if header.get("trace") and trace_id is not None:
+                trace_id = str(header["trace"])
+            led = ledgermod.MapLedger(path, store,
+                                      fsync_interval=fsync_s,
+                                      on_chunk=note_chunk)
+            led.adopt(completed)
+            led.adopt_admits(admits)
+            REPLICATOR.note(d for _, d in completed.values())
+            FLIGHT.record("store", "ledger", job=job_id,
+                          event="stream_resume",
+                          admits=len(admits), completed=len(completed))
+            return led, completed, chunksize, trace_id
+        led = ledgermod.MapLedger(path, store, fsync_interval=fsync_s,
+                                  on_chunk=note_chunk)
+        func_digest = None
+        try:
+            # The function travels BY VALUE (cloudpickle) like the
+            # classic spec payload, so the resume CLI can re-execute
+            # admitted chunks from a dead master's journal alone.
+            try:
+                import cloudpickle as _cp
+
+                func_blob = _cp.dumps(func)
+            except Exception:  # noqa: BLE001
+                func_blob = serialization.dumps(func)
+            spec_data = serialization.dumps(
+                (func_blob, bool(star), int(chunksize)))
+            func_digest = store.put_bytes(
+                spec_data, refs=1, persist=True).digest
+        except Exception:  # noqa: BLE001
+            logger.warning(
+                "ledger: stream spec for job %r not serializable; "
+                "`fiber-tpu resume` needs the original call site",
+                job_id, exc_info=True)
+        led.write_header({
+            "kind": "stream", "job_id": job_id, "task_digest": tdigest,
+            "spec": func_digest, "chunksize": int(chunksize),
+            "star": bool(star), "trace": trace_id,
+        })
+        return led, {}, chunksize, trace_id
+
+    def _release_stream_chunk(self, seq: int, base: int) -> None:
+        """A stream chunk filled: its raw-items storemiss context and
+        encoded-arg store refs are dead weight — drop them now so
+        master state stays O(window), not O(stream length)."""
+        from fiber_tpu.store.replicate import REPLICATOR
+
+        with self._seq_ctx_lock:
+            sctx = self._stream_ctx.pop((seq, base), None)
+        if sctx is None:
+            return
+        digs = sctx[1]
+        if digs:
+            REPLICATOR.forget(digs)
+            if self._objstore is not None:
+                for d in digs:
+                    self._objstore.release(d)
+
+    def _stream_cleanup(self, seq: int) -> None:
+        """Stream completion (success, failure or abort): drop every
+        per-stream table entry and release any chunk contexts that
+        never filled (failure paths)."""
+        self._stream_windows.pop(seq, None)
+        self._stream_window_orig.pop(seq, None)
+        self._stream_lazy.discard(seq)
+        with self._seq_ctx_lock:
+            leftover = [k for k in self._stream_ctx if k[0] == seq]
+        for (_s, base) in leftover:
+            self._release_stream_chunk(seq, base)
+        if not self._stream_windows:
+            _g_stream_window_fill.set(0)
+
+    def _stream_results(self, seq: int, ordered: bool, lazy: bool,
+                        ledger, chunksize: int):
+        """Consumer-side iterator for a stream: resolves deferred
+        by-reference results at yield time (the incremental-spill leg)
+        and, on an ordered durable stream, journals the consumer cursor
+        at chunk boundaries so `fiber-tpu resume` can skip the consumed
+        prefix."""
+        inner = (self._store.iter_ordered(seq) if ordered
+                 else self._store.iter_unordered(seq))
+        if ledger is None or not ordered:
+            # Unordered consumption records no cursor: a count cannot
+            # say WHICH results were consumed; resume re-emits every
+            # journaled result instead. With no per-item bookkeeping
+            # left, delegate — at 1M tiny tasks an extra Python-level
+            # loop body per item is measurable.
+            if not lazy:
+                yield from inner
+                return
+            for v in inner:
+                if isinstance(v, ObjectRef):
+                    v = self._resolve_result_refs([v])[0]
+                yield v
+            return
+        consumed = 0
+        for v in inner:
+            if lazy and isinstance(v, ObjectRef):
+                v = self._resolve_result_refs([v])[0]
+            yield v
+            consumed += 1
+            if consumed % chunksize == 0:
+                ledger.record_cursor(consumed)
+
+    def shrink_stream_window(self, factor: float = 0.5) -> int:
+        """Policy-plane hook (queue_growth -> shrink_stream_window):
+        cut every active stream's admission window, throttling a
+        runaway producer at the source. The pre-shrink width is kept
+        for the owned revert; floor one chunk so streams always
+        progress. Returns how many streams were shrunk."""
+        factor = min(1.0, max(0.05, float(factor)))
+        n = 0
+        for seq, win in list(self._stream_windows.items()):
+            new = max(1, int(win * factor))
+            if new < win:
+                self._stream_window_orig.setdefault(seq, win)
+                self._stream_windows[seq] = new
+                n += 1
+        return n
+
+    def restore_stream_window(self) -> int:
+        """Clear-edge revert of shrink_stream_window: restore every
+        still-active stream's original window. Streams that completed
+        meanwhile already dropped their state via _stream_cleanup."""
+        n = 0
+        for seq, orig in list(self._stream_window_orig.items()):
+            if self._stream_windows.get(seq, orig) != orig:
+                self._stream_windows[seq] = orig
+                n += 1
+            self._stream_window_orig.pop(seq, None)
+        return n
+
     # -- public API --------------------------------------------------------
     def apply(self, func: Callable, args: Tuple = (), kwds: Optional[Dict] = None):
         return self.apply_async(func, args, kwds).get()
@@ -3029,14 +3762,27 @@ class Pool:
         job_id: Optional[str] = None,
         budget: Optional[CostBudget] = None,
     ):
-        items = list(iterable)
-        device_out = self._device_dispatch(func, items, star=False)
-        if device_out is not None:
-            return iter(device_out)
-        res = self._submit(func, items, chunksize, False,
-                           priority=priority, job_id=job_id,
-                           budget=budget)
-        return _ResultIterator(self._store.iter_ordered(res._seq))
+        """Ordered lazy map over ANY iterable (docs/streaming.md).
+
+        With ``stream_enabled`` (the default) this is a true streaming
+        pipeline: a windowed admission loop pulls from ``iterable``
+        lazily — at most ``stream_window`` chunks are encoded + in
+        flight + un-yielded at any instant — so master memory is
+        O(window), not O(n), and a slow consumer backpressures
+        admission (which parks dispatch, which drains transport
+        credits). ``job_id=`` journals the *stream*: admitted input
+        chunks, completed result chunks, and the consumer's cursor, so
+        ``fiber-tpu resume`` works on a half-consumed stream.
+
+        With ``stream_enabled=False`` the map still accepts any
+        iterable and dispatches without a window; the input is only
+        materialized up front when ``job_id`` + ``ledger_enabled``
+        demand the classic fixed task digest (ledger identity is
+        ``f(func, n_items)``, which needs the full length — the
+        tradeoff is O(n) master RAM in exchange for the classic
+        whole-map journal format)."""
+        return self._imap_impl(func, iterable, chunksize, priority,
+                               job_id, budget, ordered=True)
 
     def imap_unordered(
         self,
@@ -3047,14 +3793,47 @@ class Pool:
         job_id: Optional[str] = None,
         budget: Optional[CostBudget] = None,
     ):
-        items = list(iterable)
-        device_out = self._device_dispatch(func, items, star=False)
-        if device_out is not None:
-            return iter(device_out)
-        res = self._submit(func, items, chunksize, False,
-                           priority=priority, job_id=job_id,
-                           budget=budget)
-        return _ResultIterator(self._store.iter_unordered(res._seq))
+        """Unordered variant of :meth:`imap` — results yield as chunks
+        complete, and each yielded slot's payload reference is released
+        immediately, so master RSS stays flat across arbitrarily long
+        streams (large results spill through the object store and are
+        resolved at yield time). Same streaming / fallback /
+        materialization rules as :meth:`imap`; an unordered durable
+        stream journals results but no consumer cursor (a position
+        count cannot identify WHICH unordered results were consumed —
+        resume re-emits every journaled result)."""
+        return self._imap_impl(func, iterable, chunksize, priority,
+                               job_id, budget, ordered=False)
+
+    def _imap_impl(self, func, iterable, chunksize, priority, job_id,
+                   budget, ordered: bool):
+        from fiber_tpu import config as _config
+
+        if self._wants_device(func):
+            # Device maps run as one mesh dispatch over the whole
+            # batch; they are the one shape that genuinely needs the
+            # materialized list.
+            return iter(self._run_device(func, list(iterable),
+                                         star=False))
+        cfg = _config.get()
+        windowed = bool(cfg.stream_enabled)
+        if (not windowed and job_id is not None
+                and bool(cfg.ledger_enabled)):
+            # Classic durable path: the whole-map ledger's identity is
+            # f(func, n_items), so the length must be known up front.
+            items = list(iterable)
+            res = self._submit(func, items, chunksize, False,
+                               priority=priority, job_id=job_id,
+                               budget=budget)
+            inner = (self._store.iter_ordered(res._seq) if ordered
+                     else self._store.iter_unordered(res._seq))
+            return _ResultIterator(inner)
+        seq, ledger, csz = self._submit_stream(
+            func, iterable, chunksize, False, priority=priority,
+            job_id=job_id if windowed else None, budget=budget,
+            windowed=windowed, ordered=ordered)
+        return _ResultIterator(self._stream_results(
+            seq, ordered, seq in self._stream_lazy, ledger, csz))
 
     # -- lifecycle ---------------------------------------------------------
     def wait_workers(self, n: Optional[int] = None,
@@ -3445,7 +4224,16 @@ class ResilientPool(Pool):
                 # first chunk already waited its turn), bounded by the
                 # knob. One frame then carries the whole range, so the
                 # master's frame count and encode CPU scale with hosts.
-                while len(items) < self._range_chunks:
+                range_cap = self._range_chunks
+                if item is not None:
+                    # Streaming maps cap the range (window-aware
+                    # handout): a whole admission window inside one
+                    # sub-master's range would starve other hosts and
+                    # defeat backpressure granularity.
+                    cap = self._taskq.range_cap(item[1][0])
+                    if cap:
+                        range_cap = min(range_cap, cap)
+                while len(items) < range_cap:
                     try:
                         extra = self._taskq.get_for(ident, host,
                                                     timeout=0)

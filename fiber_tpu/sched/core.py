@@ -178,6 +178,9 @@ class Scheduler:
         #: (budget_exceeded remediation — telemetry/policy.py). The
         #: original weight restores on unthrottle or map release.
         self._throttled: Dict[int, float] = {}
+        #: streaming maps' hier range cap (seq -> max chunks per range
+        #: handout, docs/streaming.md); popped on release_map.
+        self._range_caps: Dict[int, int] = {}
         #: saved speculation quantile while the policy plane's
         #: straggler remediation holds it boosted (None = not boosted).
         self._quantile_base: Optional[float] = None
@@ -335,6 +338,21 @@ class Scheduler:
         with self._cond:
             self._ensure_map_locked(key[0]).digests[key] = digs
 
+    def note_stream(self, seq: int, cap: int) -> None:
+        """Mark ``seq`` as a STREAMING map with a per-handout range cap
+        (docs/streaming.md "window-aware handout"): hierarchical range
+        top-ups for this map stop at ``cap`` chunks, so one sub-master
+        can never swallow a whole admission window and starve the other
+        hosts inside it."""
+        with self._cond:
+            self._range_caps[seq] = max(1, int(cap))
+
+    def range_cap(self, seq: int) -> Optional[int]:
+        """The hier range-chunk cap for ``seq`` (None: not a stream —
+        the configured ``dispatch_range_chunks`` applies unbounded)."""
+        with self._cond:
+            return self._range_caps.get(seq)
+
     def release_map(self, seq: int) -> None:
         """Drop one completed/failed map's state: queued leftovers
         (speculative duplicates, late resubmits), inflight entries, and
@@ -342,6 +360,7 @@ class Scheduler:
         with self._cond:
             st = self._maps.pop(seq, None)
             self._throttled.pop(seq, None)
+            self._range_caps.pop(seq, None)
             if st is not None:
                 self._queued -= len(st.queue)
                 st.queue.clear()

@@ -31,7 +31,16 @@ Semantics preserved relative to direct dispatch:
   one spans + one cost frame per chunk it would otherwise dominate
   master ingress; the master unpacks and dispatches each inner message
   through its normal handlers. Heartbeats are emitted by the
-  sub-master itself.
+  sub-master itself;
+* STREAMING maps (docs/streaming.md) ride unchanged: a range's chunk
+  payload bytes are the only copy of its items (the master's producer
+  iterator has moved on — the PR-4 envelope-reuse rule), so the
+  resubmission sources here (``_outstanding``) and at the master
+  (pending table / scheduler payloads) work identically for streamed
+  chunks. The scheduler additionally caps range size for streams
+  (``Scheduler.range_cap``) so one host's range cannot swallow a whole
+  admission window, and result batches flush immediately when nothing
+  is locally outstanding — a held rbatch is held backpressure.
 
 Local fan-out rides the idle C++ epoll pump (``libfiberpump.so``) when
 it is available and the engine is TCP — under ``transport_io="shm"``
@@ -222,8 +231,15 @@ class HostDispatcher:
                         first_t = time.perf_counter()
                     batch.append((seq, base, values))
                     batch_bytes += len(data)
+                    # The `not self._outstanding` leg: nothing left
+                    # in flight locally means nothing can join this
+                    # batch but the age timer — flush now. Streaming
+                    # maps with tight admission windows live on this:
+                    # the master releases window slots per rbatch, so
+                    # a held batch is held backpressure.
                     if (len(batch) >= _BATCH_CHUNKS
                             or batch_bytes >= _BATCH_BYTES
+                            or not self._outstanding
                             or time.perf_counter() - first_t
                             >= _BATCH_AGE_S):
                         self._flush(batch)

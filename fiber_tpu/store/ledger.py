@@ -103,12 +103,27 @@ def task_digest(func: Callable, n_items: int, star: bool) -> str:
     return hashlib.sha256(spec.encode()).hexdigest()
 
 
+def stream_task_digest(func: Callable, star: bool) -> str:
+    """Stream-map task identity: like :func:`task_digest` but with NO
+    item count — a stream's length is unknowable at submit time (the
+    producer may not have run yet). Same guard scope: catches job_id
+    reuse across different workloads, not same-named code edits."""
+    name = (getattr(func, "__module__", "?") or "?",
+            getattr(func, "__qualname__",
+                    getattr(func, "__name__", type(func).__name__)))
+    spec = f"{name[0]}.{name[1]}|stream|{int(bool(star))}"
+    return hashlib.sha256(spec.encode()).hexdigest()
+
+
 def load(path: str) -> Tuple[Dict[str, Any], Dict[int, Tuple[int, str]],
                              bool]:
     """Read one ledger: ``(header, completed, done)`` where completed
     maps ``base -> (n_items, payload_digest)``. A torn tail line (the
     crash landed mid-append) is skipped, never fatal; duplicate chunk
-    records (speculation / resumed runs) keep the first occurrence."""
+    records (speculation / resumed runs) keep the first occurrence.
+    Stream ledgers (``kind="stream"`` headers) load too — callers
+    branch on ``header["kind"]`` and use :func:`load_stream` for the
+    admit/cursor records."""
     header: Dict[str, Any] = {}
     completed: Dict[int, Tuple[int, str]] = {}
     done = False
@@ -128,7 +143,7 @@ def load(path: str) -> Tuple[Dict[str, Any], Dict[int, Tuple[int, str]],
                                path)
                 continue
             kind = rec.get("kind")
-            if kind == "map":
+            if kind in ("map", "stream"):
                 if int(rec.get("v", 0)) > LEDGER_VERSION:
                     raise ValueError(
                         f"ledger {path} is version {rec.get('v')}; this "
@@ -143,6 +158,59 @@ def load(path: str) -> Tuple[Dict[str, Any], Dict[int, Tuple[int, str]],
     if not header:
         raise ValueError(f"ledger {path} has no map header")
     return header, completed, done
+
+
+def load_stream(path: str) -> Tuple[Dict[str, Any],
+                                    Dict[int, Tuple[int, str]],
+                                    Dict[int, Tuple[int, str]],
+                                    int, bool]:
+    """Read one STREAM ledger: ``(header, admits, completed, cursor,
+    done)``. ``admits`` maps ``base -> (n, input_payload_digest)`` —
+    the journaled input chunks, re-executable without the (dead)
+    producer; ``completed`` maps ``base -> (n, result_digest)``;
+    ``cursor`` is the LAST journaled consumer position (last-wins, not
+    max: a fresh consumer restarting from zero must supersede the old
+    run's high-water mark). The writer queue is FIFO, so journaled
+    admits always form a contiguous prefix of admission order and
+    ``completed``'s keys are a subset of ``admits``'s."""
+    header: Dict[str, Any] = {}
+    admits: Dict[int, Tuple[int, str]] = {}
+    completed: Dict[int, Tuple[int, str]] = {}
+    cursor = 0
+    done = False
+    with open(path, "r") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                logger.warning("ledger %s: skipping torn/corrupt record",
+                               path)
+                continue
+            kind = rec.get("kind")
+            if kind == "stream":
+                if int(rec.get("v", 0)) > LEDGER_VERSION:
+                    raise ValueError(
+                        f"ledger {path} is version {rec.get('v')}; this "
+                        f"build reads <= {LEDGER_VERSION}")
+                header = rec
+            elif kind == "admit":
+                base = int(rec["base"])
+                if base not in admits:
+                    admits[base] = (int(rec["n"]), str(rec["digest"]))
+            elif kind == "chunk":
+                base = int(rec["base"])
+                if base not in completed:
+                    completed[base] = (int(rec["n"]), str(rec["digest"]))
+            elif kind == "cursor":
+                cursor = int(rec["consumed"])
+            elif kind == "done":
+                done = True
+    if not header:
+        raise ValueError(f"ledger {path} has no stream header")
+    return header, admits, completed, cursor, done
 
 
 class MapLedger:
@@ -184,6 +252,9 @@ class MapLedger:
         #: base -> (n, digest) of every durably journaled chunk,
         #: including records adopted from a prior (crashed) run.
         self.journaled: Dict[int, Tuple[int, str]] = {}
+        #: base -> (n, digest) of every journaled stream ADMIT (input
+        #: chunk payloads; empty for classic whole-map ledgers).
+        self.admitted: Dict[int, Tuple[int, str]] = {}
         self.digests: set = set()
         self.chunks_journaled = 0
         #: Disk bytes this ledger cost: journal lines (header, chunk,
@@ -208,6 +279,50 @@ class MapLedger:
     def has(self, base: int) -> bool:
         with self._cond:
             return base in self.journaled
+
+    # -- stream-ledger records (docs/streaming.md) -----------------------
+    def adopt_admits(self, admits: Dict[int, Tuple[int, str]]) -> None:
+        """Seed the admit dedup table on stream resume: the prior run's
+        admitted input chunks are durable already and must not
+        re-journal when the resumed producer re-admits them."""
+        with self._cond:
+            self.admitted.update(admits)
+            self.digests.update(d for _, d in admits.values())
+
+    def record_admit(self, base: int, n: int, items) -> bool:
+        """Queue one admitted input chunk (the stream-ledger
+        write-ahead leg): the writer persists the chunk's ITEMS into
+        the store's disk tier and appends an ``admit`` record, so
+        ``fiber-tpu resume`` can re-execute the chunk after a master
+        crash without the (gone) producer iterator. Same hot-loop cost
+        contract as record_chunk."""
+        with self._cond:
+            if self._closed or base in self.admitted:
+                return False
+            self.admitted[base] = (int(n), "")
+            self._queue.append(("admit", base, int(n), items))
+            self._pending += 1
+            self._cond.notify_all()
+        return True
+
+    def record_cursor(self, consumed: int) -> bool:
+        """Queue the consumer's position (count of results yielded, in
+        order). Safe after close — the consumer may still be draining
+        yielded results when the map's completion callbacks close the
+        ledger; a dropped cursor only costs re-emitting a few consumed
+        results on resume, never correctness. Pending cursor records
+        coalesce: only the newest position is worth an fsync."""
+        with self._cond:
+            if self._closed:
+                return False
+            for i, rec in enumerate(self._queue):
+                if rec[0] == "cursor":
+                    self._queue[i] = ("cursor", int(consumed))
+                    return True
+            self._queue.append(("cursor", int(consumed)))
+            self._pending += 1
+            self._cond.notify_all()
+        return True
 
     def record_chunk(self, base: int, n: int, values) -> bool:
         """Queue one completed chunk's result values for journaling —
@@ -331,6 +446,27 @@ class MapLedger:
     def _durable_record(self, rec) -> Optional[str]:
         if rec[0] == "done":
             return json.dumps({"kind": "done"})
+        if rec[0] == "cursor":
+            return json.dumps({"kind": "cursor", "consumed": rec[1]})
+        if rec[0] == "admit":
+            _, base, n, items = rec
+            payload = serialization.dumps(items)
+            digest = digest_of(payload)
+            # Payload first, record second — same orphan-over-dangling
+            # rule as result chunks.
+            self._store.put_bytes(payload, refs=1, persist=True,
+                                  digest=digest)
+            with self._cond:
+                self.admitted[base] = (n, digest)
+                self.digests.add(digest)
+                self.bytes_written += len(payload)
+            if self._on_chunk is not None:
+                try:  # admits are precious too: resume needs them
+                    self._on_chunk(digest)
+                except Exception:  # noqa: BLE001 - hook is observational
+                    pass
+            return json.dumps({"kind": "admit", "base": base, "n": n,
+                               "digest": digest})
         _, base, n, values = rec
         payload = serialization.dumps(values)
         digest = digest_of(payload)

@@ -28,6 +28,10 @@ budget_exceeded       throttle the offending (tenant, job): cut the WDRR
                       restore on clear
 tx_queue_high         tighten the transport TX high-water so senders
                       feel backpressure earlier; restore on clear
+queue_growth          shrink the admission window of active streaming
+                      maps (docs/streaming.md) so a runaway producer
+                      parks instead of filling master RAM; restore the
+                      original windows on clear
 ====================  =================================================
 
 Verification closes the loop: ``policy_verify_s`` after an action the
@@ -245,6 +249,49 @@ def _act_budget(record: Dict[str, Any], dry_run: bool):
                   f"{key_str(key)}: WDRR weight cut 4x"), revert
 
 
+def _act_queue_growth(record: Dict[str, Any], dry_run: bool):
+    """queue_growth: a monotonically growing task queue means the
+    producer outruns the cluster — for streaming maps the source is
+    throttleable, so halve every active stream's admission window
+    (docs/streaming.md): admission parks sooner, the queue drains, and
+    the producer feels backpressure instead of filling master RAM.
+    Restores the original windows on the clear edge."""
+    pools = [p for p in list(_POOLS)
+             if getattr(p, "_stream_windows", None)]
+    if dry_run:
+        streams = sum(len(p._stream_windows) for p in pools)
+        return False, (f"would halve the admission window of {streams} "
+                       f"active stream(s) across {len(pools)} "
+                       "pool(s)"), None
+    hit: List["weakref.ref"] = []
+    n = 0
+    for pool in pools:
+        try:
+            shrunk = pool.shrink_stream_window(factor=0.5)
+        except Exception:  # noqa: BLE001 - one pool must not stop the rest
+            logger.exception("policy: stream-window shrink failed")
+            continue
+        if shrunk:
+            n += shrunk
+            hit.append(weakref.ref(pool))
+    if not n:
+        return False, ("no active streaming map in this process; "
+                       "queue growth is not admission-driven"), None
+
+    def revert() -> None:
+        for pref in hit:
+            p = pref()
+            if p is not None:
+                try:
+                    p.restore_stream_window()
+                except Exception:  # noqa: BLE001 - best-effort restore
+                    pass
+
+    return True, (f"halved the admission window of {n} active "
+                  "stream(s) — producer parks sooner, queue "
+                  "drains"), revert
+
+
 def _act_tx_queue_high(record: Dict[str, Any], dry_run: bool):
     from fiber_tpu.transport import evloop
 
@@ -298,6 +345,8 @@ _DEFAULT_POLICIES: Tuple[Policy, ...] = (
            knob="CostBudget caps"),
     Policy("tx_queue_high", "tighten_tx_highwater", _act_tx_queue_high,
            knob="anomaly_tx_queue_mb"),
+    Policy("queue_growth", "shrink_stream_window", _act_queue_growth,
+           knob="stream_window"),
 )
 
 

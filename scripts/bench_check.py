@@ -61,6 +61,10 @@ DIRECTIONS: Dict[str, str] = {
     # on-but-idle engine overhead must not creep up
     "autonomy_soak_lost_tasks": "lower",
     "autonomy_gates": "special",
+    # streaming data plane (bench-stream): RSS growth across a 100x
+    # task-count increase must stay flat, streamed-vs-materialized
+    # throughput must not drift down
+    "stream_gates": "special",
 }
 
 #: "special" metrics gate named RATIO FIELDS instead of "value"
@@ -76,6 +80,8 @@ RATIO_FIELDS: Dict[str, List[Tuple[str, str]]] = {
                        ("resume_ratio", "lower")],
     "autonomy_gates": [("idle_overhead", "lower"),
                        ("chains_linked", "higher")],
+    "stream_gates": [("rss_ratio", "lower"),
+                     ("tps_ratio", "higher")],
 }
 
 

@@ -16,6 +16,7 @@ from fiber_tpu.telemetry.flightrec import FLIGHT, order_events
 from fiber_tpu.telemetry.monitor import AnomalyWatchdog, WATCHDOG
 from fiber_tpu.telemetry.policy import POLICY
 from fiber_tpu.telemetry.timeseries import TIMESERIES
+from tests import targets
 
 
 @pytest.fixture(autouse=True)
@@ -210,6 +211,52 @@ def test_budget_exceeded_throttles_registered_pools():
     assert act["applied"] and "2 in-flight map(s)" in act["detail"]
     dog.external_clear("budget_exceeded")
     assert pool.restored == [("acme", "train-7", "m3")]
+
+
+def test_queue_growth_shrinks_stream_window_then_reverts():
+    """queue_growth -> shrink_stream_window (docs/streaming.md): a
+    sustained queue-depth breach halves every ACTIVE stream's admission
+    window (admission parks sooner, the queue stops growing at the
+    source); the clear edge restores the original windows via the
+    policy's owned revert."""
+
+    def gen():
+        for i in range(200):
+            yield i
+
+    dog = _fresh_watchdog(stream_window=8)
+    with fiber_tpu.Pool(2) as pool:
+        # window 8 x chunk 4 admits at most ~36 of the 200 items while
+        # the consumer sits at 8 — the stream is live mid-drill
+        it = pool.imap(targets.square, gen(), chunksize=4)
+        for _ in range(8):
+            next(it)
+        [seq] = list(pool._stream_windows)
+        assert pool._stream_windows[seq] == 8
+        dog.external_breach("queue_growth",
+                            detail="depth 100 over 3 ticks",
+                            depth=100.0)
+        assert pool._stream_windows[seq] == 4
+        act = POLICY.recent_actions()[-1]
+        assert act["rule"] == "queue_growth" and act["applied"]
+        assert act["action"] == "shrink_stream_window"
+        # the live admission loop re-reads the window each tick, so
+        # the shrink takes effect without touching the stream
+        dog.external_clear("queue_growth")
+        assert pool._stream_windows[seq] == 8
+        assert [e["kind"] for e in _policy_events("revert")] == ["revert"]
+        # the stream still makes progress after shrink + revert —
+        # drain it fully so join() sees nothing outstanding
+        assert next(it) == 8 * 8
+        assert list(it) == [i * i for i in range(9, 200)]
+
+
+def test_queue_growth_without_streams_declines():
+    dog = _fresh_watchdog()
+    dog.external_breach("queue_growth", detail="depth 100", depth=100.0)
+    act = POLICY.recent_actions()[-1]
+    assert act["rule"] == "queue_growth" and not act["applied"]
+    assert "no active streaming map" in act["detail"]
 
 
 # ---------------------------------------------------------------------------

@@ -6,7 +6,7 @@ PYTEST ?= python -m pytest tests/ -q
 .PHONY: test stest test-all lint bench bench-store bench-telemetry \
 	bench-sched bench-transport bench-cluster bench-recovery \
 	bench-accounting bench-check bench-scale bench-ici \
-	bench-autonomy bench-stream weakscale docs chaos
+	bench-autonomy bench-stream bench-serve weakscale docs chaos
 
 # Tier 1: local backend (subprocess jobs)
 test:
@@ -44,6 +44,8 @@ chaos:
 		python -m pytest tests/test_chaos.py -q
 	FIBER_CHAOS_SEED=606 FIBER_STREAM_WINDOW=4 \
 		python -m pytest tests/test_stream.py -q
+	FIBER_CHAOS_SEED=707 python -m pytest tests/test_serve_daemon.py \
+		-q -m slow
 
 # FIBER_BENCH_ENFORCE: fail loudly when the 1 ms host-pool point
 # drifts past its budget (the driver's plain `python bench.py` only
@@ -123,6 +125,19 @@ bench-scale:
 	JAX_PLATFORMS=cpu python bench.py --scale --record > BENCH_scale.json; \
 	rc=$$?; cat BENCH_scale.json; exit $$rc
 
+# Serving-tier gate (docs/serving.md): one long-lived daemon, N
+# tenants x M concurrent jobs over the authenticated channel. FAILS
+# when the WDRR fairness ratio across equal tenants exceeds 1.6x, when
+# the over-budget tenant is not throttled-then-PREEMPTED (parked
+# resumable, chunks reclaimed), when a SIGKILL'd client's or SIGKILL'd
+# daemon's jobs lose a task or double-bill one (exactly-once
+# tasks + tasks_restored reconciliation per disjoint tenant record),
+# or when a job on standby warm workers takes more than 0.5x the cold
+# Pool-spawn wall. The record lands in BENCH_serve.json either way.
+bench-serve:
+	JAX_PLATFORMS=cpu python bench.py --serve --record > BENCH_serve.json; \
+	rc=$$?; cat BENCH_serve.json; exit $$rc
+
 # Streaming data plane gate (docs/streaming.md): a million tiny tasks
 # through a windowed imap_unordered over a generator — nothing
 # materialized anywhere. FAILS when the run completes < 1M tasks, when
@@ -178,6 +193,7 @@ weakscale:
 
 lint:
 	python -m compileall -q fiber_tpu examples bench.py __graft_entry__.py
+	python scripts/check_pycache.py fiber_tpu examples tests scripts
 
 # Docs site (reference parity: built mkdocs site). Prefers mkdocs when
 # installed; otherwise the zero-dependency renderer (same mkdocs.yml nav).

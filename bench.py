@@ -1683,6 +1683,370 @@ def _stream_bench(args) -> int:
     return 1 if (short or fat or slow) else 0
 
 
+#: `make bench-serve` gates (docs/serving.md): equal tenants pushing
+#: equal work through ONE daemon must see near-equal mean job latency
+#: (WDRR fairness), and a job landing on standby warm workers must
+#: start-to-finish in at most half the cold Pool-spawn wall.
+_SERVE_FAIRNESS_MAX = 1.6
+_SERVE_WARM_RATIO_MAX = 0.5
+
+
+def _serve_daemon_env(staging: str, repo: str) -> dict:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never reach for a real pod
+    env.update(
+        FIBER_BACKEND="local",
+        JAX_PLATFORMS="cpu",
+        FIBER_AGENT_STAGING=staging,
+        PYTHONPATH=repo,
+        FIBER_SERVE_PROCESSES="4",
+        FIBER_SERVE_WARM_FLOOR="2",
+        FIBER_SERVE_WARM_CEILING="4",
+        FIBER_SERVE_WARM_IDLE_S="1.0",
+        FIBER_SERVE_TICK_S="0.1",
+        FIBER_SERVE_PREEMPT_GRACE_S="0.5",
+    )
+    return env
+
+
+def _serve_spawn(portfile: str, env: dict, repo: str):
+    """Spawn one serving daemon on an ephemeral port; return
+    (proc, port) once the --port-file lands."""
+    import subprocess
+
+    # log to a FILE, not a pipe: a full 64K pipe buffer would wedge a
+    # chatty daemon mid-bench
+    with open(portfile + ".log", "w") as log:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "fiber_tpu.serve.daemon",
+             "--port", "0", "--port-file", portfile],
+            env=env, cwd=repo, stdout=log, stderr=subprocess.STDOUT)
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            with open(portfile + ".log") as fh:
+                raise RuntimeError(
+                    "serve daemon died on startup:\n" + fh.read())
+        if os.path.exists(portfile):
+            with open(portfile) as fh:
+                return proc, int(fh.read())
+        time.sleep(0.05)
+    proc.kill()
+    raise RuntimeError("serve daemon never published its port")
+
+
+def _serve_ledger_chunks(path) -> int:
+    from fiber_tpu.store import ledger as ledgermod
+
+    try:
+        _, completed, _ = ledgermod.load(path)
+        return len(completed)
+    except Exception:  # noqa: BLE001 - not written yet
+        return 0
+
+
+def _serve_cost_total(job_id: str, costs_dir: str, want: int,
+                      deadline_s: float = 60.0):
+    """Retry-poll one job's cost record until tasks + tasks_restored
+    reconciles to ``want`` (records are eventually consistent: late
+    worker frames rewrite them). Returns the record or None."""
+    from fiber_tpu.telemetry import accounting
+
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        rec = accounting.read_job_record(job_id, directory=costs_dir)
+        if rec:
+            total = rec.get("total", {})
+            billed = (int(total.get("tasks", 0))
+                      + int(total.get("tasks_restored", 0)))
+            if billed == want:
+                return rec
+        time.sleep(0.1)
+    return None
+
+
+def _serve_bench(args) -> int:
+    """Serving-daemon macrobench (docs/serving.md, `make bench-serve`):
+    one daemon, N tenants x M concurrent jobs over the authenticated
+    channel, an over-budget tenant that must be throttled then
+    PREEMPTED (parked resumable, chunks reclaimed), a client SIGKILLed
+    mid-job whose results a fresh client still collects, a daemon
+    SIGKILLed mid-jobs whose restart replays everything exactly-once,
+    and a warm-vs-cold first-job latency arm. Gates: WDRR fairness
+    ratio, warm latency ratio, zero lost tasks, disjoint per-tenant
+    cost records reconciling to totals."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="fiber-bench-serve-")
+    staging = os.path.join(tmp, "staging")
+    cold_staging = os.path.join(tmp, "cold-staging")
+    os.makedirs(staging)
+    os.makedirs(cold_staging)
+    # The bench's own cold-Pool arm stays in a private staging dir so
+    # it cannot collide with the daemon's ledgers/costs.
+    os.environ["FIBER_BACKEND"] = "local"
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["FIBER_AGENT_STAGING"] = cold_staging
+    import fiber_tpu
+    from fiber_tpu.serve.client import ServeClient
+    from fiber_tpu.store import ledger as ledgermod
+    from tests import targets
+
+    ledger_dir = os.path.join(staging, "ledger")
+    costs_dir = os.path.join(staging, "costs")
+    env = _serve_daemon_env(staging, repo)
+    tenants = [f"tenant{i}" for i in range(max(2, int(args.serve_tenants)))]
+    jobs_per = max(2, int(args.serve_jobs))
+    n = int(args.serve_tasks)
+    failures: list = []
+    procs: list = []
+    try:
+        proc, port = _serve_spawn(os.path.join(tmp, "port1"), env, repo)
+        procs.append(proc)
+        client = ServeClient(("127.0.0.1", port))
+
+        # -- phase A: fairness + budget preemption ----------------------
+        # The hog submits FIRST (2n tasks, a 5-task budget): WDRR must
+        # keep it from starving anyone while it lives, and admission
+        # must preempt it after the grace window.
+        greedy_job = client.submit(
+            targets.sleep_echo, list(range(2 * n)), tenant="greedy",
+            job_id="greedy-hog", chunksize=1, budget={"tasks": 5})
+        fair = {t: [client.submit(targets.sleep_echo, list(range(n)),
+                                  tenant=t, chunksize=1)
+                    for _ in range(jobs_per)]
+                for t in tenants}
+        lost = 0
+        views = {}
+        for t in tenants:
+            for j in fair[t]:
+                view = client.wait(j, timeout=600)
+                views[j] = view
+                if view.get("state") != "done":
+                    failures.append(f"fair job {j} ended "
+                                    f"{view.get('state')}: "
+                                    f"{view.get('error')}")
+                    lost += n
+                    continue
+                res = client.results(j)
+                ok = sum(1 for a, b in zip(res, range(n)) if a == b)
+                lost += n - ok
+        gview = client.wait(greedy_job, timeout=600)
+        preempted_ok = gview.get("state") == "preempted"
+        if not preempted_ok:
+            failures.append(f"over-budget job ended "
+                            f"{gview.get('state')!r}, wanted preempted")
+        gpath = ledgermod.job_path(greedy_job, ledger_dir)
+        journaled = _serve_ledger_chunks(gpath)
+        if not (0 < journaled < 2 * n):
+            failures.append(f"preempted job journaled {journaled} "
+                            f"chunks; want 0 < j < {2 * n} (parked "
+                            "resumable, chunks reclaimed)")
+        status_a = client.status()
+        preempted_maps = int(
+            status_a["admission"].get("preempted_maps", 0))
+        if preempted_maps < 1:
+            failures.append("admission reported no preempted maps")
+        scaleup_ok = int(status_a["warm_pool"].get("scale_ups", 0)) >= 1
+        if not scaleup_ok:
+            failures.append("warm pool never scaled above the floor "
+                            "under full load")
+        means = {}
+        for t in tenants:
+            lat = [views[j]["finished_at"] - views[j]["submitted_at"]
+                   for j in fair[t] if views[j].get("finished_at")]
+            means[t] = sum(lat) / len(lat) if lat else float("inf")
+        fairness_ratio = (max(means.values()) / max(1e-9,
+                                                    min(means.values())))
+        _emit({"metric": "serve_fairness_ratio",
+               "value": round(fairness_ratio, 3), "unit": "x",
+               "tenants": len(tenants), "jobs_per_tenant": jobs_per,
+               "tasks_per_job": n,
+               "mean_latency_s": {t: round(v, 3)
+                                  for t, v in means.items()}})
+        # Per-tenant cost records: DISJOINT (each job billed to its own
+        # tenant) and reconciling to the grand total.
+        billed = 0
+        for t in tenants:
+            for j in fair[t]:
+                rec = _serve_cost_total(j, costs_dir, n)
+                if rec is None:
+                    failures.append(f"cost record for {j} never "
+                                    f"reconciled to {n} tasks")
+                    continue
+                if rec.get("tenant") != t:
+                    failures.append(f"job {j} billed to "
+                                    f"{rec.get('tenant')!r}, not {t!r}")
+                billed += int(rec["total"].get("tasks", 0))
+                billed += int(rec["total"].get("tasks_restored", 0))
+        want_billed = len(tenants) * jobs_per * n
+        if billed != want_billed:
+            failures.append(f"cost records total {billed} tasks across "
+                            f"tenants; submitted {want_billed}")
+
+        # -- phase B: client SIGKILLed mid-job --------------------------
+        victim_job = "victim-killed-client"
+        code = (
+            "import sys\n"
+            "from fiber_tpu.serve.client import ServeClient\n"
+            "from tests import targets\n"
+            "port, job, n = (int(sys.argv[1]), sys.argv[2],\n"
+            "                int(sys.argv[3]))\n"
+            "c = ServeClient(('127.0.0.1', port))\n"
+            "c.submit(targets.sleep_echo, list(range(n)),\n"
+            "         tenant='victim', job_id=job, chunksize=2)\n"
+            "c.wait(job)\n"
+        )
+        vic = subprocess.Popen(
+            [sys.executable, "-c", code, str(port), victim_job, str(n)],
+            env=env, cwd=repo)
+        vpath = ledgermod.job_path(victim_job, ledger_dir)
+        deadline = time.time() + 120
+        while (time.time() < deadline
+               and _serve_ledger_chunks(vpath) < 2):
+            time.sleep(0.05)
+        vic.kill()
+        vic.wait(timeout=60)
+        # the job outlives its submitter: a DIFFERENT client collects
+        vview = client.wait(victim_job, timeout=600)
+        vres = (client.results(victim_job)
+                if vview.get("state") == "done" else [])
+        client_survive_ok = vres == list(range(n))
+        if not client_survive_ok:
+            failures.append(
+                f"killed-client job ended {vview.get('state')!r} with "
+                f"{len(vres)}/{n} results — submissions must outlive "
+                "their submitter")
+
+        # -- phase C: daemon SIGKILLed mid-jobs, restart replays --------
+        crash_jobs = {}
+        for t in ("carol", "dave"):
+            crash_jobs[t] = client.submit(
+                targets.sleep_echo, list(range(n)), tenant=t,
+                job_id=f"{t}-crash", chunksize=2)
+        deadline = time.time() + 120
+        while time.time() < deadline and not all(
+                _serve_ledger_chunks(
+                    ledgermod.job_path(j, ledger_dir)) >= 2
+                for j in crash_jobs.values()):
+            time.sleep(0.05)
+        proc.kill()
+        proc.wait(timeout=60)
+        client.close()
+        time.sleep(1.0)  # let orphaned workers drain
+        proc2, port2 = _serve_spawn(os.path.join(tmp, "port2"), env,
+                                    repo)
+        procs.append(proc2)
+        client2 = ServeClient(("127.0.0.1", port2))
+        replay_ok = True
+        for t, j in crash_jobs.items():
+            view = client2.wait(j, timeout=600)
+            if not (view.get("state") == "done" and view.get("replayed")
+                    and client2.results(j) == list(range(n))):
+                replay_ok = False
+                failures.append(
+                    f"job {j} after daemon kill+restart: state="
+                    f"{view.get('state')!r} "
+                    f"replayed={view.get('replayed')!r}")
+                continue
+            rec = _serve_cost_total(j, costs_dir, n)
+            if rec is None:
+                replay_ok = False
+                failures.append(f"replayed job {j} never reconciled "
+                                f"to exactly {n} billed tasks")
+            elif int(rec["total"].get("tasks_restored", 0)) < 1:
+                replay_ok = False
+                failures.append(f"replayed job {j} restored 0 chunks "
+                                "from its ledger")
+
+        # -- phase D: warm-vs-cold first-job latency --------------------
+        # Wait out the idle window: the pool must shrink BACK to the
+        # warm floor (elastic down as well as up) before the timed arm.
+        scaledown_ok = False
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            warm = client2.status()["warm_pool"]
+            if int(warm["workers"]) == int(warm["floor"]):
+                scaledown_ok = True
+                break
+            time.sleep(0.1)
+        if not scaledown_ok:
+            failures.append("warm pool never scaled back down to the "
+                            f"floor when idle ({warm})")
+        t0 = time.perf_counter()
+        wjob = client2.submit(targets.square, [7], tenant="newcomer")
+        wview = client2.wait(wjob, timeout=120, interval=0.01)
+        warm_s = time.perf_counter() - t0
+        if not (wview.get("state") == "done"
+                and client2.results(wjob) == [49]):
+            failures.append(f"warm-arm job ended {wview.get('state')!r}")
+        fiber_tpu.init()
+        t0 = time.perf_counter()
+        with fiber_tpu.Pool(2) as pool:
+            cold_res = pool.map(targets.square, [7])
+        cold_s = time.perf_counter() - t0
+        if cold_res != [49]:
+            failures.append(f"cold-arm map returned {cold_res!r}")
+        warm_ratio = warm_s / max(1e-9, cold_s)
+        _emit({"metric": "serve_warm_latency",
+               "value": round(warm_ratio, 3), "unit": "x cold spawn",
+               "warm_s": round(warm_s, 3), "cold_s": round(cold_s, 3)})
+        if warm_ratio > _SERVE_WARM_RATIO_MAX:
+            failures.append(
+                f"warm first-job latency {round(warm_ratio, 3)}x the "
+                f"cold Pool spawn (max {_SERVE_WARM_RATIO_MAX}x) — the "
+                "standby workers bought nothing")
+
+        # -- phase E: clean shutdown over the wire ----------------------
+        client2.shutdown()
+        client2.close()
+        try:
+            rc = proc2.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            rc = None
+        if rc != 0:
+            failures.append(f"daemon exit code {rc!r} after the "
+                            "shutdown verb; want 0")
+
+        if fairness_ratio > _SERVE_FAIRNESS_MAX:
+            failures.append(
+                f"tenant fairness ratio {round(fairness_ratio, 3)}x "
+                f"(max {_SERVE_FAIRNESS_MAX}x) — WDRR is not holding")
+        if lost:
+            failures.append(f"{lost} task result(s) lost or wrong "
+                            "across the fair tenants")
+        _emit({"metric": "serve_gates",
+               "value": round(fairness_ratio, 3), "unit": "x",
+               "fairness_ratio": round(fairness_ratio, 3),
+               "warm_latency_ratio": round(warm_ratio, 3),
+               "lost_tasks": lost,
+               "billed_tasks": billed,
+               "preempted_maps": preempted_maps,
+               "preempted_ok": preempted_ok,
+               "client_survive_ok": client_survive_ok,
+               "replay_ok": replay_ok,
+               "scaleup_ok": scaleup_ok,
+               "scaledown_ok": scaledown_ok,
+               "fairness_max": _SERVE_FAIRNESS_MAX,
+               "warm_ratio_max": _SERVE_WARM_RATIO_MAX,
+               "under_floor": bool(failures)})
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1 if failures else 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                try:
+                    p.wait(timeout=30)
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 #: `make bench-ici` gates (docs/objectstore.md "Device tier"): repeat
 #: resolutions of an already-device-resident param may cost at most
 #: this many wire bytes (control frames only — the payload must come
@@ -2028,6 +2392,22 @@ def main() -> int:
                              "default)")
     parser.add_argument("--scale-workers", type=int, default=4,
                         help="sub-worker count for both --scale arms")
+    parser.add_argument("--serve", action="store_true",
+                        help="serving-daemon macrobench "
+                             "(docs/serving.md): N tenants x M jobs "
+                             "through one daemon; gates WDRR fairness, "
+                             "budget preemption (parked resumable), "
+                             "killed-client and killed-daemon "
+                             "exactly-once recovery, disjoint cost "
+                             "reconciliation, and warm-vs-cold "
+                             "first-job latency")
+    parser.add_argument("--serve-tenants", type=int, default=3,
+                        help="equal-workload tenants for the --serve "
+                             "fairness arm (>= 2)")
+    parser.add_argument("--serve-jobs", type=int, default=2,
+                        help="concurrent jobs per tenant (>= 2)")
+    parser.add_argument("--serve-tasks", type=int, default=40,
+                        help="tasks per job for every --serve arm")
     parser.add_argument("--ici", action="store_true",
                         help="device-tier data plane bench "
                              "(docs/objectstore.md 'Device tier'): "
@@ -2060,11 +2440,11 @@ def main() -> int:
             args.lm, args.store, args.telemetry, args.sched,
             args.transport, args.cluster, args.recovery,
             args.accounting, args.scale, args.ici,
-            args.autonomy, args.stream)) > 1:
+            args.autonomy, args.stream, args.serve)) > 1:
         parser.error("--poet/--pixels/--biped/--attention/--lm/--store/"
                      "--telemetry/--sched/--transport/--cluster/"
                      "--recovery/--accounting/--scale/--ici/--autonomy/"
-                     "--stream are mutually exclusive")
+                     "--stream/--serve are mutually exclusive")
     if args.record:
         _arm_record()
     if args.store:
@@ -2091,6 +2471,8 @@ def main() -> int:
         return _scale_bench(args)  # host-plane only, like --store
     if args.stream:
         return _stream_bench(args)  # host-plane only, like --store
+    if args.serve:
+        return _serve_bench(args)  # host-plane only, like --store
     if args.ici:
         return _ici_bench(args)  # CPU mesh stands in for the pod
     if args.pop is not None and args.pop < 2:

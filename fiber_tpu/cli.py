@@ -903,6 +903,32 @@ def cmd_top(args) -> int:
                     print("costs (per billing key, top by cpu_s):")
                     for row in _render_cost_rows(costs, args.last):
                         print(row)
+                if getattr(args, "serve", ""):
+                    # Serving-tier daemon state (docs/serving.md): job
+                    # counts by state, warm-pool size and admission
+                    # denials from the daemon's status verb.
+                    from fiber_tpu.serve.client import ServeClient
+
+                    sc = ServeClient(_serve_address(args.serve))
+                    try:
+                        st = sc.status()
+                        jobs_s = " ".join(
+                            f"{k}={v}" for k, v in sorted(
+                                (st.get("jobs") or {}).items())) or "none"
+                        warm = st.get("warm_pool") or {}
+                        adm = st.get("admission") or {}
+                        print(f"serve: pid={st.get('pid')} "
+                              f"up={st.get('uptime_s', 0.0):.0f}s "
+                              f"jobs[{jobs_s}] "
+                              f"workers={warm.get('workers')}"
+                              f"/{warm.get('floor')}-{warm.get('ceiling')} "
+                              f"denied={sum((adm.get('denied') or {}).values())} "
+                              f"preempted={adm.get('preempted_maps', 0)}")
+                    except Exception as err:  # noqa: BLE001
+                        print(f"serve: unreachable ({err!r})")
+                        rc = 1
+                    finally:
+                        sc.close()
                 sys.stdout.flush()
             frames += 1
             if args.iterations and frames >= args.iterations:
@@ -1601,14 +1627,21 @@ def storemod_local_for_ledger():
 
 
 def cmd_jobs(args) -> int:
-    """List durable-map ledgers (job id, chunk counts, done flag)."""
+    """List durable-map ledgers (job id, tenant, chunk counts, done
+    flag). ``--tenant`` filters on the tenant column, which is sourced
+    from the accounting plane's persisted per-job cost records
+    (``<staging>/costs/<job>.json``) — a job with no record yet (still
+    running, or accounting disabled) shows ``-`` and survives the
+    filter only when no filter is set."""
     from fiber_tpu.store import ledger as ledgermod
+    from fiber_tpu.telemetry import accounting
 
     jobs = ledgermod.list_jobs(args.ledger_dir or None)
     if not jobs:
         print("no job ledgers under "
               f"{args.ledger_dir or ledgermod.default_ledger_dir()}")
         return 0
+    shown = 0
     for job in jobs:
         try:
             header, completed, done = ledgermod.load(
@@ -1616,15 +1649,18 @@ def cmd_jobs(args) -> int:
         except (OSError, ValueError) as err:
             print(f"{job}  unreadable ({err})", file=sys.stderr)
             continue
+        # Historical cost (accounting plane): the record a completed
+        # run persisted beside this ledger, when one exists. Its tenant
+        # field is the serve tier's billing identity for the job.
+        record = accounting.read_job_record(job)
+        tenant = (record or {}).get("tenant")
+        want = getattr(args, "tenant", "") or ""
+        if want and tenant != want:
+            continue
         n_items = int(header.get("n_items") or 0)
-        line = (f"{job}  tasks={n_items} "
+        line = (f"{job}  tenant={tenant or '-'} tasks={n_items} "
                 f"journaled_chunks={len(completed)} "
                 f"{'done' if done else 'RESUMABLE'}")
-        # Historical cost (accounting plane): the record a completed
-        # run persisted beside this ledger, when one exists.
-        from fiber_tpu.telemetry import accounting
-
-        record = accounting.read_job_record(job)
         if record is not None:
             total = record.get("total") or {}
             line += (f"  cost: cpu={total.get('cpu_s', 0.0):.2f}s "
@@ -1632,7 +1668,119 @@ def cmd_jobs(args) -> int:
                      f"tasks={int(total.get('tasks', 0))}"
                      f"+{int(total.get('tasks_restored', 0))}r")
         print(line)
+        shown += 1
+    if not shown and getattr(args, "tenant", ""):
+        print(f"no jobs billed to tenant {args.tenant!r}")
     return 0
+
+
+def _serve_address(text: str):
+    """Parse ``host:port`` / ``:port`` / ``port`` into an address tuple
+    (default host 127.0.0.1, default port from config serve_port)."""
+    from fiber_tpu import config as _config
+
+    host, port = "127.0.0.1", int(_config.get().serve_port)
+    text = (text or "").strip()
+    if text:
+        if ":" in text:
+            h, _, p = text.rpartition(":")
+            host = h or host
+            port = int(p)
+        elif text.isdigit():
+            port = int(text)
+        else:
+            host = text
+    return host, port
+
+
+def cmd_serve(args) -> int:
+    """Run the long-lived multi-tenant serving daemon
+    (docs/serving.md)."""
+    from fiber_tpu.serve import daemon as servemod
+
+    argv = []
+    if args.backend:
+        argv += ["--backend", args.backend]
+    if args.port:
+        argv += ["--port", str(args.port)]
+    if args.bind:
+        argv += ["--bind", args.bind]
+    if args.processes:
+        argv += ["--processes", str(args.processes)]
+    return servemod.main(argv)
+
+
+def cmd_submit(args) -> int:
+    """Submit one job to a running serve daemon and (optionally) wait:
+    the function is ``module:function``, the items a JSON list."""
+    import importlib
+
+    from fiber_tpu.serve.client import ServeClient, ServeError
+
+    if ":" not in args.func:
+        raise SystemExit("error: func must look like module:function")
+    mod_name, _, fn_name = args.func.partition(":")
+    sys.path.insert(0, os.getcwd())
+    try:
+        fn = getattr(importlib.import_module(mod_name), fn_name)
+    except (ImportError, AttributeError) as err:
+        raise SystemExit(f"error: cannot load {args.func!r}: {err}") \
+            from None
+    try:
+        items = json.loads(args.items)
+    except ValueError as err:
+        raise SystemExit(f"error: --items is not JSON: {err}") from None
+    if not isinstance(items, list):
+        raise SystemExit("error: --items must be a JSON list")
+    budget = None
+    if args.budget:
+        try:
+            budget = json.loads(args.budget)
+        except ValueError as err:
+            raise SystemExit(
+                f"error: --budget is not JSON: {err}") from None
+    client = ServeClient(_serve_address(args.serve))
+    try:
+        job_id = client.submit(fn, items, tenant=args.tenant,
+                               job_id=args.job_id or None,
+                               star=args.star,
+                               chunksize=args.chunksize or None,
+                               budget=budget)
+        if not args.wait:
+            print(json.dumps({"job_id": job_id, "state": "submitted"}))
+            return 0
+        view = client.wait(job_id)
+        out = dict(view)
+        if view.get("state") == "done":
+            results = client.results(job_id)
+            out["results"] = len(results)
+            if args.out:
+                from fiber_tpu import serialization
+
+                with open(args.out, "wb") as fh:
+                    fh.write(serialization.dumps(results))
+                out["out"] = args.out
+        print(json.dumps(out))
+        return 0 if view.get("state") == "done" else 1
+    except ServeError as err:
+        raise SystemExit(f"error: {err}") from None
+    finally:
+        client.close()
+
+
+def cmd_cancel(args) -> int:
+    """Cancel a running serve-daemon job (parked resumable: its ledger
+    survives, so resubmitting the same job_id resumes it)."""
+    from fiber_tpu.serve.client import ServeClient, ServeError
+
+    client = ServeClient(_serve_address(args.serve))
+    try:
+        print(json.dumps(client.cancel(args.job_id)))
+        return 0
+    except ServeError as err:
+        raise SystemExit(f"error: {err}") from None
+    finally:
+        client.close()
 
 
 def cmd_logs(args) -> int:
@@ -1817,6 +1965,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "device seconds)")
     p.add_argument("--json", action="store_true",
                    help="print raw per-host monitor snapshots as JSON")
+    p.add_argument("--serve", default="",
+                   help="also show a serve daemon's state (jobs by "
+                        "state, warm pool, admission); host:port, "
+                        "default port from serve_port config")
     p.set_defaults(fn=cmd_top)
 
     p = sub.add_parser(
@@ -1985,7 +2137,59 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("jobs",
                        help="list durable-map ledgers and their state")
     p.add_argument("--ledger-dir", default="")
+    p.add_argument("--tenant", default="",
+                   help="only jobs billed to this tenant (from the "
+                        "persisted per-job cost records)")
     p.set_defaults(fn=cmd_jobs)
+
+    p = sub.add_parser(
+        "serve", help="run the long-lived multi-tenant serving daemon "
+                      "(submit/poll/cancel over the authenticated "
+                      "cluster channel)")
+    p.add_argument("--backend", default="", choices=("", "local", "tpu"))
+    p.add_argument("--port", type=int, default=0,
+                   help="RPC port (default: serve_port config)")
+    p.add_argument("--bind", default="127.0.0.1",
+                   help="interface to bind; non-loopback requires "
+                        "FIBER_CLUSTER_KEY")
+    p.add_argument("--processes", type=int, default=0,
+                   help="worker-slot ceiling for the shared pool")
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "submit", help="submit one job to a running serve daemon")
+    p.add_argument("func", help="module:function (importable on the "
+                                "daemon's PYTHONPATH)")
+    p.add_argument("--items", required=True,
+                   help="JSON list of task items")
+    p.add_argument("--tenant", default="default")
+    p.add_argument("--job-id", default="",
+                   help="durable job id (generated when omitted); "
+                        "resubmitting an id resumes its ledger")
+    p.add_argument("--star", action="store_true",
+                   help="starmap: each item is an argument tuple")
+    p.add_argument("--chunksize", type=int, default=0)
+    p.add_argument("--budget", default="",
+                   help='JSON CostBudget fields, e.g. '
+                        '\'{"tasks": 100, "cpu_s": 5}\'')
+    p.add_argument("--serve", default="",
+                   help="daemon address host:port (default "
+                        "127.0.0.1:<serve_port>)")
+    p.add_argument("--wait", action="store_true",
+                   help="poll until the job finishes and print the "
+                        "final state")
+    p.add_argument("--out", default="",
+                   help="with --wait: write the result list (pickled) "
+                        "here")
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser(
+        "cancel", help="cancel a serve-daemon job (parked resumable)")
+    p.add_argument("job_id")
+    p.add_argument("--serve", default="",
+                   help="daemon address host:port (default "
+                        "127.0.0.1:<serve_port>)")
+    p.set_defaults(fn=cmd_cancel)
 
     p = sub.add_parser("logs", help="fetch a job's log tail by jid")
     p.add_argument("jid", help="host:port/jobid (as printed by --submit)")

@@ -290,6 +290,41 @@ DEFAULTS: Dict[str, Any] = {
     # Per-job cost record directory. "" = <staging root>/costs, beside
     # the ledger/ directory `fiber-tpu jobs` reads.
     "cost_dir": "",
+    # --- serving tier (docs/serving.md) ---
+    # `fiber-tpu serve` daemon: a long-lived multi-tenant front door
+    # multiplexing many clients' jobs onto one shared pool, with
+    # admission control, budget preemption and a warm worker pool.
+    # RPC port the daemon listens on (authenticated with
+    # FIBER_CLUSTER_KEY, same plane as the host agents).
+    "serve_port": 7070,
+    # Worker-slot ceiling for the shared pool; 0 = cpu_count().
+    "serve_processes": 0,
+    # Warm pool floor: standby workers kept spawned even when idle, so
+    # a newly admitted tenant's first chunk skips cold spawn latency.
+    "serve_warm_floor": 2,
+    # Warm pool ceiling; 0 = serve_processes (fully elastic in range).
+    "serve_warm_ceiling": 0,
+    # Idle seconds (zero in-flight + zero queued chunks) before the
+    # warm pool scales back down to the floor.
+    "serve_warm_idle_s": 5.0,
+    # Daemon housekeeping tick, seconds: admission escalation sweep +
+    # warm pool scaling decisions.
+    "serve_tick_s": 0.5,
+    # Per-tenant admission quotas; 0 = unlimited. Checked at submit
+    # against the accounting plane's live cost vectors.
+    "serve_tenant_jobs": 0,        # concurrent running jobs per tenant
+    "serve_tenant_tasks": 0,       # cumulative submitted tasks per tenant
+    "serve_tenant_cpu_s": 0.0,     # cumulative worker CPU seconds per tenant
+    # Watchdog anomaly rules whose STANDING (active) state refuses new
+    # admissions; comma-separated.
+    "serve_deny_rules": "store_disk_fill,hbm_fill",
+    # Grace period, seconds, between a tenant's budget_exceeded anomaly
+    # (WDRR throttle, the policy plane's first response) and escalation
+    # to actual preemption (job parked resumable, chunks reclaimed).
+    "serve_preempt_grace_s": 2.0,
+    # Serve-tier job journal directory. "" = <staging root>/serve,
+    # beside ledger/ and costs/.
+    "serve_dir": "",
     # --- TPU backend ---
     "tpu_name": "",
     "tpu_zone": "",

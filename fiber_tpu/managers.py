@@ -17,6 +17,7 @@ choices kept from the reference:
 from __future__ import annotations
 
 import queue as pyqueue
+import socket
 import threading
 import traceback
 from multiprocessing.connection import Client, Listener
@@ -137,6 +138,16 @@ class Server:
                 self._stop.set()
                 try:
                     self._listener.close()
+                except OSError:
+                    pass
+                # Wake the parked accept — closing the fd alone doesn't:
+                # the in-flight accept syscall pins the listen socket
+                # open, so one drain connect completes it and the loop
+                # sees the stop flag (same pattern as ServeDaemon.stop).
+                # Without this the server process never exits and the
+                # parent's shutdown() burns its full join timeout.
+                try:
+                    socket.create_connection(self.address, 0.5).close()
                 except OSError:
                     pass
                 raise SystemExit(0)

@@ -112,6 +112,14 @@ class WorkerStartError(Exception):
     (reference posture: fiber/pool.py:96-104 safe_start)."""
 
 
+class JobPreemptedError(Exception):
+    """The serve tier preempted this map mid-flight (budget enforcement,
+    docs/serving.md): its journaled progress is intact in the ledger and
+    the job is resumable via ``fiber-tpu resume`` / daemon replay. Raised
+    into the map's waiters so a blocked ``pool.map`` call unblocks with a
+    recognizable, non-fatal verdict rather than hanging."""
+
+
 class RemoteError(Exception):
     """An exception raised inside a pool worker, with remote traceback."""
 
@@ -1775,6 +1783,49 @@ class Pool:
     def _on_worker_death(self, proc) -> None:
         logger.debug("pool worker %s died", proc.name)
 
+    def resize(self, processes: int) -> int:
+        """Retarget the worker count in place — the serve tier's warm
+        pool (docs/serving.md) scales one long-lived pool elastically
+        instead of paying cold spawn per tenant.
+
+        Scale-UP spawns immediately (and starts the maintain loop if no
+        map has run yet, so standby capacity is warm BEFORE the first
+        chunk needs it). Scale-DOWN terminates excess workers without
+        touching the books: the maintain loop's existing dead-sweep
+        observes the exits and runs the normal death path — for the
+        resilient pool that reclaims + resubmits anything a victim
+        still owed, so callers that scale down under load degrade to a
+        resubmit, never a loss (callers are expected to scale down only
+        when idle anyway). Returns the new target."""
+        target = max(1, int(processes))
+        victims = []
+        with self._workers_lock:
+            self._n_workers = target
+            covered = (
+                sum(getattr(p, "_n_local", 1) for p in self._workers)
+                + self._spawning_slots
+            )
+            excess = covered - target
+            if excess > 0:
+                for p in self._workers:
+                    if excess <= 0:
+                        break
+                    n_local = getattr(p, "_n_local", 1)
+                    if n_local > excess:
+                        continue  # would overshoot below the target
+                    victims.append(p)
+                    excess -= n_local
+        self._sched.set_n_workers(target)
+        for p in victims:
+            try:
+                p.terminate()
+            except Exception:  # noqa: BLE001 - already-dead is fine
+                pass
+        if not self._closed and not self._terminated:
+            self._start_worker_thread()
+            self._maintain_workers()
+        return target
+
     # -- scheduler plane hooks (fiber_tpu/sched) ---------------------------
     def _on_sched_work(self) -> None:
         """The speculation monitor queued a duplicate: parked requests'
@@ -2555,6 +2606,57 @@ class Pool:
         return sum(1 for seq in seqs
                    if self._sched.unthrottle_map(seq))
 
+    def preempt_map(self, seq: int) -> bool:
+        """Stop one in-flight map NOW, keeping it resumable (the serve
+        tier's budget-enforcement escalation past WDRR throttling,
+        docs/serving.md). Order matters:
+
+        1. pop the ledger and close it WITHOUT a ``done`` record — the
+           journal keeps every chunk completed so far, and the missing
+           ``done`` is exactly what makes ``fiber-tpu resume`` (and the
+           serve daemon's replay) pick the job back up;
+        2. fail the map's unset slots with :class:`JobPreemptedError` —
+           the completion callbacks this fires do the actual reclaim:
+           ``release_map`` drops the map's queued AND in-flight chunks
+           from the scheduler (late results for a released seq are
+           already ignored), ``_ledger_done`` no-ops (ledger popped in
+           step 1), ``_finish_billing`` seals and persists the cost
+           record so the tenant is billed for what actually ran.
+
+        Returns False when ``seq`` already completed (nothing to do)."""
+        if self._store.is_done(seq):
+            return False
+        led = self._ledgers.pop(seq, None)
+        if led is not None:
+            from fiber_tpu.store.replicate import REPLICATOR
+
+            led.close()
+            REPLICATOR.forget(led.digests)
+        self._store.fail(
+            seq,
+            JobPreemptedError(
+                f"map seq={seq} preempted by the serve tier "
+                "(budget enforcement); journaled progress kept — "
+                "resumable via `fiber-tpu resume`"),
+            reason="preempted", direct=True)
+        return True
+
+    def preempt_billing_key(self, key) -> int:
+        """Preempt every in-flight map billed to ``key`` (a
+        ``(tenant, job, map)`` tuple). Returns how many maps were
+        actually stopped."""
+        key = tuple(key)
+        seqs = [seq for seq, bk in list(self._seq_bill.items())
+                if bk == key]
+        return sum(1 for seq in seqs if self.preempt_map(seq))
+
+    def preempt_job(self, job_id: str) -> int:
+        """Preempt every in-flight map billed to ``job_id`` regardless
+        of tenant/map component. Returns how many maps were stopped."""
+        seqs = [seq for seq, bk in list(self._seq_bill.items())
+                if len(bk) >= 2 and bk[1] == job_id]
+        return sum(1 for seq in seqs if self.preempt_map(seq))
+
     def cost(self, job_id: Optional[str] = None) -> Dict[str, Any]:
         """Per-map/per-tenant CostReports (docs/observability.md
         "Resource accounting"): the process cost ledger's keys merged
@@ -2788,6 +2890,7 @@ class Pool:
         priority: float = 1.0,
         job_id: Optional[str] = None,
         budget: Optional[CostBudget] = None,
+        tenant: Optional[str] = None,
     ) -> AsyncResult:
         if self._closed or self._terminated:
             raise ValueError("Pool not running")
@@ -2806,7 +2909,10 @@ class Pool:
         # their chunk costs to the same key, and an optional CostBudget
         # raises the budget_exceeded anomaly when crossed.
         mid = next(_MAP_IDS)
-        bill_key = (COSTS.tenant,
+        # tenant= overrides the process-wide COSTS.tenant: the serve
+        # daemon multiplexes many tenants' jobs through ONE pool, so
+        # billing identity must be per-map, not per-process.
+        bill_key = (tenant if tenant else COSTS.tenant,
                     job_id if job_id is not None else f"map-{mid}",
                     f"m{mid}")
         if COSTS.enabled:
@@ -3635,7 +3741,7 @@ class Pool:
 
     def _dispatch_async(self, func, items, star, chunksize,
                         callback, error_callback, priority=1.0,
-                        job_id=None, budget=None):
+                        job_id=None, budget=None, tenant=None):
         """Device-or-host submission shared by every map variant, with
         async error contracts preserved on the device path (user-function
         errors reach error_callback / .get(); only pool-state errors
@@ -3652,7 +3758,7 @@ class Pool:
             return self._submit(func, items, chunksize, star,
                                 callback, error_callback,
                                 priority=priority, job_id=job_id,
-                                budget=budget)
+                                budget=budget, tenant=tenant)
         if job_id is not None:
             # Device dispatch is one mesh call, not a chunk stream —
             # there is nothing partial to journal or resume.
@@ -3692,6 +3798,7 @@ class Pool:
         priority: float = 1.0,
         job_id: Optional[str] = None,
         budget: Optional[CostBudget] = None,
+        tenant: Optional[str] = None,
     ) -> List[Any]:
         """``job_id=`` makes the map durable (docs/robustness.md): the
         task spec and every completed chunk are journaled write-ahead
@@ -3707,7 +3814,7 @@ class Pool:
         Measurement, not enforcement — the map keeps running."""
         return self.map_async(func, iterable, chunksize,
                               priority=priority, job_id=job_id,
-                              budget=budget).get()
+                              budget=budget, tenant=tenant).get()
 
     def map_async(
         self,
@@ -3719,10 +3826,12 @@ class Pool:
         priority: float = 1.0,
         job_id: Optional[str] = None,
         budget: Optional[CostBudget] = None,
+        tenant: Optional[str] = None,
     ):
         return self._dispatch_async(func, list(iterable), False, chunksize,
                                     callback, error_callback, priority,
-                                    job_id=job_id, budget=budget)
+                                    job_id=job_id, budget=budget,
+                                    tenant=tenant)
 
     def starmap(
         self,
@@ -3732,10 +3841,11 @@ class Pool:
         priority: float = 1.0,
         job_id: Optional[str] = None,
         budget: Optional[CostBudget] = None,
+        tenant: Optional[str] = None,
     ) -> List[Any]:
         return self.starmap_async(func, iterable, chunksize,
                                   priority=priority, job_id=job_id,
-                                  budget=budget).get()
+                                  budget=budget, tenant=tenant).get()
 
     def starmap_async(
         self,
@@ -3747,11 +3857,13 @@ class Pool:
         priority: float = 1.0,
         job_id: Optional[str] = None,
         budget: Optional[CostBudget] = None,
+        tenant: Optional[str] = None,
     ):
         return self._dispatch_async(func, [tuple(t) for t in iterable],
                                     True, chunksize, callback,
                                     error_callback, priority,
-                                    job_id=job_id, budget=budget)
+                                    job_id=job_id, budget=budget,
+                                    tenant=tenant)
 
     def imap(
         self,

@@ -244,6 +244,23 @@ class Scheduler:
     def empty(self) -> bool:
         return self.qsize() == 0
 
+    def set_n_workers(self, n: int) -> None:
+        """Retarget the worker-count estimate used by the speculation
+        idle heuristic. Called by :meth:`fiber_tpu.pool.Pool.resize`
+        (the serve tier's warm pool) — handout itself is demand-driven
+        per requesting worker, so no queued state needs rebuilding."""
+        with self._cond:
+            self._n_workers = max(1, int(n))
+
+    def load(self) -> Tuple[int, int]:
+        """``(inflight_chunks, queued_chunks)`` snapshot — the warm
+        pool's scaling signal (the same numbers the
+        ``sched_host_inflight_chunks`` gauge and ``qsize`` export, read
+        in one lock hold so the pair is consistent)."""
+        with self._cond:
+            return sum(len(h) for h in self._inflight.values()), \
+                self._queued
+
     def _get(self, ident, host, timeout):
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)

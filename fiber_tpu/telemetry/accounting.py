@@ -50,9 +50,15 @@ historical cost.
 
 **Soft budgets**: :class:`CostBudget` caps registered per key raise the
 ``budget_exceeded`` watchdog anomaly (flight event + counter + log
-warning, edge-triggered once per map) when a running map crosses them —
-the enforcement hook ``serve`` admission control and preemption will
-later call.
+warning, edge-triggered once per map) when a running map crosses them.
+Enforcement lives in the serving tier (docs/serving.md): the policy
+plane's ``throttle_tenant`` cuts the offender's WDRR weight on the
+breach edge, and the serve daemon's admission controller
+(:meth:`fiber_tpu.serve.admission.AdmissionController.tick`) escalates
+a breach that outlives ``serve_preempt_grace_s`` to real preemption —
+``Pool.preempt_billing_key`` parks the job resumable with its ledger
+intact — while :meth:`~fiber_tpu.serve.admission.AdmissionController.check`
+refuses new admissions against per-tenant quotas over these vectors.
 """
 
 from __future__ import annotations
@@ -156,9 +162,12 @@ class CostBudget:
 
     Every limit is optional; a running map whose combined cost vector
     crosses ANY set limit raises the ``budget_exceeded`` watchdog
-    anomaly (+ flight event) exactly once. Enforcement (kill /
-    preempt / refuse admission) is deliberately left to the caller —
-    this is the measurement hook the serve tier builds on."""
+    anomaly (+ flight event) exactly once. This is the measurement
+    hook; enforcement landed in the serve tier (docs/serving.md):
+    WDRR throttling on the breach edge (telemetry/policy.py), then
+    preemption after ``serve_preempt_grace_s`` via
+    ``fiber_tpu.serve.admission`` + ``Pool.preempt_billing_key`` —
+    the job parks ``preempted`` with its ledger intact, resumable."""
 
     __slots__ = ("cpu_s", "wire_mb", "device_s", "wall_s", "tasks")
 

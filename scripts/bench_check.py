@@ -65,6 +65,9 @@ DIRECTIONS: Dict[str, str] = {
     # task-count increase must stay flat, streamed-vs-materialized
     # throughput must not drift down
     "stream_gates": "special",
+    # serving daemon (bench-serve): tenant fairness and warm-start
+    # latency must not drift, lost tasks must stay at 0
+    "serve_gates": "special",
 }
 
 #: "special" metrics gate named RATIO FIELDS instead of "value"
@@ -82,6 +85,9 @@ RATIO_FIELDS: Dict[str, List[Tuple[str, str]]] = {
                        ("chains_linked", "higher")],
     "stream_gates": [("rss_ratio", "lower"),
                      ("tps_ratio", "higher")],
+    "serve_gates": [("fairness_ratio", "lower"),
+                    ("warm_latency_ratio", "lower"),
+                    ("lost_tasks", "lower")],
 }
 
 

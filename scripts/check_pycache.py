@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Lint guard: fail on orphaned ``__pycache__`` entries.
+
+A ``__pycache__`` directory whose compiled files have no matching
+``.py`` source beside it means a module was deleted (or never
+committed) while its stale bytecode stayed behind — the exact state
+the repo shipped in once: ``fiber_tpu/serve/__pycache__`` held
+compiled orphans for a package whose sources did not exist. Stale
+bytecode is dead weight at best and a confusing archaeology trap at
+worst, so the lint gate (``make lint``) fails the build until the
+orphans are deleted or their sources restored.
+
+Usage: ``python scripts/check_pycache.py [root ...]`` (default ``.``).
+Exit 0 when clean, 1 with a listing when orphans exist.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List
+
+SKIP_DIRS = {".git", ".venv", "venv", "node_modules", ".tox", ".eggs"}
+
+
+def scan(root: str) -> List[str]:
+    orphans: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        if os.path.basename(dirpath) != "__pycache__":
+            dirnames[:] = [d for d in dirnames
+                           if d not in SKIP_DIRS and not d.startswith(".")]
+            continue
+        dirnames[:] = []  # nothing legitimate nests under __pycache__
+        parent = os.path.dirname(dirpath)
+        for name in filenames:
+            if not name.endswith((".pyc", ".pyo")):
+                continue
+            # foo.cpython-311.pyc / foo.cpython-311.opt-1.pyc -> foo.py
+            stem = name.split(".", 1)[0]
+            if not os.path.exists(os.path.join(parent, stem + ".py")):
+                orphans.append(os.path.join(dirpath, name))
+    return sorted(orphans)
+
+
+def main(argv=None) -> int:
+    roots = list(argv if argv is not None else sys.argv[1:]) or ["."]
+    orphans: List[str] = []
+    for root in roots:
+        orphans.extend(scan(root))
+    if orphans:
+        print("orphaned __pycache__ entries (no matching .py source):",
+              file=sys.stderr)
+        for path in orphans:
+            print(f"  {path}", file=sys.stderr)
+        print("delete the stale bytecode or restore the sources.",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

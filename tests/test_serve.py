@@ -178,6 +178,94 @@ class _RecordingHandler(logging.Handler):
         self.records.append(record)
 
 
+def test_request_reply_concurrent_mixed_authkeys():
+    """The serve daemon's posture under a REALISTIC mixed load: N
+    concurrent clients where good and wrong-key dialers interleave.
+    Every good-key client must get ITS OWN replies back in order (the
+    per-connection handler threads share one ``answer`` but must never
+    cross wires), every bad-key client must be refused, and the
+    auth-failure warning must stay rate-limited — one line for the
+    whole burst, not one per failure."""
+    handler = _RecordingHandler()
+    flogger = logging.getLogger("fiber_tpu")
+    flogger.addHandler(handler)
+    listener = Listener(("127.0.0.1", 0))
+    port = listener.address[1]
+    stop = threading.Event()
+
+    def answer(request):
+        time.sleep(0.02)  # force overlap between connection threads
+        return ("echo", request)
+
+    t = threading.Thread(
+        target=serve.serve_request_reply,
+        args=(listener, KEY, stop, answer, "test-mixed-load"),
+        daemon=True,
+    )
+    t.start()
+
+    lock = threading.Lock()
+    good = {}
+    refused = []
+    errors = []
+
+    def good_client(i):
+        try:
+            c = Client(("127.0.0.1", port), authkey=KEY)
+            try:
+                for k in range(3):
+                    c.send(("req", i, k))
+                    with lock:
+                        good.setdefault(i, []).append(c.recv())
+            finally:
+                c.close()
+        except Exception as exc:  # noqa: BLE001 - assert below
+            with lock:
+                errors.append((i, repr(exc)))
+
+    def bad_client(i):
+        try:
+            Client(("127.0.0.1", port), authkey=b"wrong-key-%d" % i)
+            with lock:
+                errors.append((i, "wrong key connected"))
+        except (AuthenticationError, EOFError, OSError):
+            with lock:
+                refused.append(i)
+
+    threads = []
+    for i in range(6):
+        threads.append(threading.Thread(target=good_client, args=(i,)))
+        threads.append(threading.Thread(target=bad_client, args=(i,)))
+    try:
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(30)
+            assert not th.is_alive()
+        assert errors == []
+        # no cross-talk: each client saw exactly its own three echoes,
+        # in its own send order
+        assert set(good) == set(range(6))
+        for i, replies in good.items():
+            assert replies == [(True, ("echo", ("req", i, k)))
+                               for k in range(3)], (i, replies)
+        assert sorted(refused) == list(range(6))
+        # rate-limited: six wrong-key peers, at most one warning burst
+        time.sleep(0.5)  # let any (wrongly) unthrottled extras land
+        hits = [r for r in handler.records
+                if "failed authentication" in r.getMessage()]
+        assert len(hits) == 1, [r.getMessage() for r in hits]
+    finally:
+        flogger.removeHandler(handler)
+        stop.set()
+        listener.close()
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.5).close()
+        except OSError:
+            pass
+        t.join(10)
+
+
 def test_real_auth_failure_logged_rate_limited():
     """Regression: a REAL peer failing the HMAC challenge (mismatched
     FIBER_CLUSTER_KEY) must leave a server-side warning — previously the

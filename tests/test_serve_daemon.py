@@ -1,0 +1,542 @@
+"""``fiber-tpu serve`` (docs/serving.md): daemon + client roundtrips,
+admission control, budget escalation to preemption, the elastic warm
+pool, daemon-restart replay, and the pycache-orphan lint guard.
+
+Coverage map:
+* multi-tenant submit/poll/results/jobs through one in-process daemon;
+* admission denials: per-tenant job quota, standing watchdog anomaly
+  on the deny list;
+* the budget escalation ladder: a breach that outlives
+  ``serve_preempt_grace_s`` parks the job ``preempted`` with its
+  ledger intact, and resubmitting the SAME job id completes it with
+  the exactly-once ``tasks + tasks_restored`` split;
+* client cancel rides the same preemption path (state ``cancelled``);
+* warm pool elasticity: prewarm to the floor, scale-up under load,
+  scale-down after the idle window;
+* the headline restart drill: a SUBPROCESS daemon SIGKILL'd with TWO
+  tenants' jobs mid-flight; a fresh daemon replays both from their
+  ledgers and a NEW client (the submitters are gone) polls full
+  results — exactly-once per job;
+* scripts/check_pycache.py flags orphaned compiled files.
+"""
+
+import contextlib
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import fiber_tpu
+from fiber_tpu import config
+from fiber_tpu.serve import protocol
+from fiber_tpu.serve.client import ServeClient, ServeError
+from fiber_tpu.serve.daemon import ServeDaemon
+from fiber_tpu.serve.jobs import JobRunner
+from fiber_tpu.store import ledger as ledgermod
+from fiber_tpu.telemetry import accounting
+from tests import targets
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _unique_job(tag: str) -> str:
+    return f"{tag}-{os.getpid()}-{int.from_bytes(os.urandom(4), 'big')}"
+
+
+@contextlib.contextmanager
+def _cfg(**knobs):
+    cfg = config.get()
+    old = {k: getattr(cfg, k) for k in knobs}
+    cfg.update(**knobs)
+    try:
+        yield
+    finally:
+        cfg.update(**old)
+
+
+@contextlib.contextmanager
+def _daemon(tmp_path, processes=2, **knobs):
+    """In-process daemon on an ephemeral port with a PRIVATE job
+    journal (the shared staging journal would make this daemon replay
+    other tests' jobs at startup)."""
+    with _cfg(**knobs):
+        runner = JobRunner(processes=processes,
+                           journal_dir=str(tmp_path / "serve-journal"))
+        daemon = ServeDaemon(port=0, runner=runner)
+        daemon.start_background()
+        client = ServeClient(("127.0.0.1", daemon.port))
+        try:
+            yield daemon, client
+        finally:
+            client.close()
+            daemon.stop(terminate_pool=True)
+
+
+def _poll(predicate, deadline_s=30.0, interval=0.05, what="condition"):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# roundtrip + multi-tenant read side
+# ---------------------------------------------------------------------------
+
+
+def test_daemon_roundtrip_two_tenants(tmp_path):
+    with _daemon(tmp_path, serve_warm_floor=1,
+                 serve_tick_s=0.05) as (daemon, client):
+        assert client.ping() == "pong"
+        a = client.submit(targets.square, range(12), tenant="alice",
+                          job_id=_unique_job("alice-rt"))
+        b = client.submit(targets.square, range(8), tenant="bob",
+                          job_id=_unique_job("bob-rt"))
+        va = client.wait(a, timeout=60)
+        vb = client.wait(b, timeout=60)
+        assert va["state"] == protocol.DONE, va
+        assert vb["state"] == protocol.DONE, vb
+        assert client.results(a) == [i * i for i in range(12)]
+        assert client.results(b) == [i * i for i in range(8)]
+        # the jobs verb filters by tenant and never leaks across
+        mine = client.jobs(tenant="alice")
+        assert [j["job_id"] for j in mine] == [a]
+        assert {j["tenant"] for j in client.jobs()} == {"alice", "bob"}
+        status = client.status()
+        assert status["jobs"].get(protocol.DONE) == 2
+        assert status["protocol"] == protocol.PROTOCOL_VERSION
+        assert status["pool_alive"] is True
+        # a disconnect-and-return client: a FRESH connection (modeling
+        # a client that died after submit) polls the same verdict
+        with ServeClient(("127.0.0.1", daemon.port)) as late:
+            assert late.poll(a)["state"] == protocol.DONE
+            assert late.results(a) == [i * i for i in range(12)]
+
+
+def test_submit_rejects_bad_tenant_and_duplicate_job(tmp_path):
+    with _daemon(tmp_path, serve_warm_floor=1,
+                 serve_tick_s=0.05) as (_daemon_obj, client):
+        with pytest.raises(ValueError):
+            client.submit(targets.square, [1], tenant="no/slashes")
+        job = _unique_job("dup")
+        client.submit(targets.sleep_echo, range(40), tenant="alice",
+                      job_id=job, chunksize=1)
+        with pytest.raises(ServeError, match="already"):
+            client.submit(targets.sleep_echo, range(40),
+                          tenant="alice", job_id=job, chunksize=1)
+        assert client.wait(job, timeout=60)["state"] == protocol.DONE
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+
+def test_admission_denies_over_job_quota_per_tenant(tmp_path):
+    with _daemon(tmp_path, serve_warm_floor=1, serve_tick_s=0.05,
+                 serve_tenant_jobs=1) as (_daemon_obj, client):
+        a = client.submit(targets.sleep_echo, range(40),
+                          tenant="alice", job_id=_unique_job("qa"),
+                          chunksize=1)
+        with pytest.raises(ServeError, match="quota_jobs"):
+            client.submit(targets.sleep_echo, range(4), tenant="alice",
+                          job_id=_unique_job("qa2"))
+        # the quota is PER tenant: bob is unaffected by alice's load
+        b = client.submit(targets.square, range(4), tenant="bob",
+                          job_id=_unique_job("qb"))
+        assert client.wait(a, timeout=60)["state"] == protocol.DONE
+        assert client.wait(b, timeout=60)["state"] == protocol.DONE
+        denied = client.status()["admission"]["denied"]
+        assert denied.get("quota_jobs") == 1
+        # quota freed by completion: alice can submit again
+        c = client.submit(targets.square, range(4), tenant="alice",
+                          job_id=_unique_job("qa3"))
+        assert client.wait(c, timeout=60)["state"] == protocol.DONE
+
+
+def test_admission_denies_on_standing_deny_rule_anomaly(tmp_path):
+    from fiber_tpu.telemetry.monitor import WATCHDOG
+
+    with _daemon(tmp_path, serve_warm_floor=1,
+                 serve_tick_s=0.05) as (_daemon_obj, client):
+        WATCHDOG.external_breach("store_disk_fill",
+                                 "disk 97% full (test)")
+        try:
+            with pytest.raises(ServeError, match="unhealthy"):
+                client.submit(targets.square, range(4), tenant="alice",
+                              job_id=_unique_job("deny"))
+        finally:
+            WATCHDOG.external_clear("store_disk_fill")
+        # anomaly cleared: the same submission is admitted
+        job = client.submit(targets.square, range(4), tenant="alice",
+                            job_id=_unique_job("deny-ok"))
+        assert client.wait(job, timeout=60)["state"] == protocol.DONE
+
+
+# ---------------------------------------------------------------------------
+# budget escalation: throttle -> preempt -> park resumable
+# ---------------------------------------------------------------------------
+
+
+def test_budget_breach_escalates_to_preemption_then_resumes(tmp_path):
+    job = _unique_job("greedy")
+    n = 60
+    with _daemon(tmp_path, serve_warm_floor=1, serve_tick_s=0.05,
+                 serve_preempt_grace_s=0.3) as (daemon, client):
+        client.submit(targets.sleep_echo, range(n), tenant="greedy",
+                      job_id=job, chunksize=1, budget={"tasks": 4})
+        view = _poll(
+            lambda: (lambda v: v if v["state"]
+                     in protocol.TERMINAL_STATES else None)(
+                         client.poll(job)),
+            deadline_s=60, what="budget preemption")
+        assert view["state"] == protocol.PREEMPTED, view
+        assert "JobPreemptedError" in (view["error"] or "")
+        stats = client.status()["admission"]
+        assert stats["preempted_maps"] >= 1
+        # parked RESUMABLE: the ledger has journaled progress, no done
+        # record, and fewer chunks than the full map
+        header, completed, done = ledgermod.load(ledgermod.job_path(job))
+        assert not done
+        assert 0 < len(completed) < n
+        journaled = len(completed)
+        # the SAME job id resubmitted (sans budget) completes from the
+        # journal: restored chunks are billed tasks_restored, not tasks
+        client.submit(targets.sleep_echo, range(n), tenant="greedy",
+                      job_id=job, chunksize=1)
+        assert client.wait(job, timeout=120)["state"] == protocol.DONE
+        assert client.results(job) == list(range(n))
+
+        def record_converged():
+            rec = accounting.read_job_record(job)
+            if not rec:
+                return None
+            total = rec.get("total") or {}
+            tasks = int(total.get("tasks", 0))
+            restored = int(total.get("tasks_restored", 0))
+            # cost records are eventually consistent (late worker
+            # frames re-write them); poll until the split reconciles
+            if restored and tasks + restored == n:
+                return rec
+            return None
+
+        rec = _poll(record_converged, deadline_s=30,
+                    what=f"exactly-once cost record for {job}")
+        assert int(rec["total"]["tasks_restored"]) == journaled
+        _, completed_after, done_after = ledgermod.load(
+            ledgermod.job_path(job))
+        assert done_after and len(completed_after) == n
+
+
+def test_cancel_parks_cancelled_and_resumable(tmp_path):
+    job = _unique_job("cancelme")
+    with _daemon(tmp_path, serve_warm_floor=1,
+                 serve_tick_s=0.05) as (_daemon_obj, client):
+        client.submit(targets.sleep_echo, range(60), tenant="alice",
+                      job_id=job, chunksize=1)
+        _poll(lambda: ledgermod.load(
+            ledgermod.job_path(job))[1] or None,
+            deadline_s=60, what="first journaled chunk")
+        client.cancel(job)
+        view = client.wait(job, timeout=60)
+        assert view["state"] == protocol.CANCELLED, view
+        _, _completed, done = ledgermod.load(ledgermod.job_path(job))
+        assert not done  # resumable, exactly like a budget preemption
+        client.submit(targets.sleep_echo, range(60), tenant="alice",
+                      job_id=job, chunksize=1)
+        assert client.wait(job, timeout=120)["state"] == protocol.DONE
+        assert client.results(job) == list(range(60))
+
+
+# ---------------------------------------------------------------------------
+# warm pool elasticity
+# ---------------------------------------------------------------------------
+
+
+def test_warm_pool_scales_up_under_load_and_back_to_floor(tmp_path):
+    with _daemon(tmp_path, processes=3, serve_warm_floor=1,
+                 serve_warm_ceiling=3, serve_warm_idle_s=0.3,
+                 serve_tick_s=0.05) as (daemon, client):
+        # prewarm brought the 3-slot pool DOWN to the floor
+        assert daemon.runner.pool._n_workers == 1
+        job = client.submit(targets.sleep_echo, range(40),
+                            tenant="alice", job_id=_unique_job("warm"),
+                            chunksize=1)
+        _poll(lambda: client.status()["warm_pool"]["scale_ups"] >= 1
+              or None, deadline_s=60, what="warm-pool scale-up")
+        assert daemon.runner.pool._n_workers > 1
+        assert client.wait(job, timeout=120)["state"] == protocol.DONE
+        # idle window elapses -> back to the floor
+        _poll(lambda: (client.status()["warm_pool"]["scale_downs"] >= 1
+                       and daemon.runner.pool._n_workers == 1) or None,
+              deadline_s=60, what="warm-pool scale-down to floor")
+        stats = client.status()["warm_pool"]
+        assert stats["floor"] == 1 and stats["workers"] == 1
+
+
+# ---------------------------------------------------------------------------
+# restart drill: SIGKILL the daemon with two tenants in flight
+# ---------------------------------------------------------------------------
+
+def _spawn_daemon(portfile, env):
+    # log to a FILE, not a pipe: a full 64K pipe buffer would wedge
+    # the daemon mid-drill
+    log_path = portfile + ".log"
+    log = open(log_path, "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fiber_tpu.serve.daemon", "--port", "0",
+         "--port-file", portfile], env=env,
+        cwd=REPO_ROOT, stdout=log, stderr=subprocess.STDOUT)
+    log.close()
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if os.path.exists(portfile):
+            with open(portfile) as fh:
+                return proc, int(fh.read())
+        if proc.poll() is not None:
+            with open(log_path) as fh:
+                tail = fh.read()[-4000:]
+            raise AssertionError(
+                f"daemon died during startup: rc={proc.returncode}\n"
+                f"{tail}")
+        time.sleep(0.05)
+    proc.kill()
+    raise AssertionError("daemon never published its port")
+
+
+def test_daemon_sigkill_mid_jobs_then_restart_replays_both_tenants(
+        tmp_path):
+    """The serving tier's headline durability drill: SIGKILL the
+    daemon process while TWO tenants' jobs are mid-flight, start a
+    fresh daemon on the same staging root, and a NEW client (both
+    submitters are gone with the old connections) polls BOTH jobs to
+    completion with full results — the replay path restores journaled
+    chunks and re-executes only the remainder, proven per job by the
+    cost record's ``tasks + tasks_restored == n`` split."""
+    staging = tmp_path / "staging"
+    env = dict(
+        os.environ,
+        FIBER_BACKEND="local",
+        FIBER_AGENT_STAGING=str(staging),
+        PYTHONPATH=REPO_ROOT,
+        FIBER_SERVE_PROCESSES="2",
+        FIBER_SERVE_WARM_FLOOR="1",
+        FIBER_SERVE_TICK_S="0.1",
+    )
+    jobs = {
+        "alice": (_unique_job("alice-crash"), 100),
+        "bob": (_unique_job("bob-crash"), 60),
+    }
+    proc, port = _spawn_daemon(str(tmp_path / "port1"), env)
+    try:
+        with ServeClient(("127.0.0.1", port)) as client:
+            for tenant, (job, n) in jobs.items():
+                client.submit(targets.sleep_echo, range(n),
+                              tenant=tenant, job_id=job, chunksize=2)
+
+            def both_mid_flight():
+                for job, _n in jobs.values():
+                    path = ledgermod.job_path(
+                        job, str(staging / "ledger"))
+                    if not os.path.exists(path):
+                        return None
+                    _h, completed, done = ledgermod.load(path)
+                    if done or len(completed) < 2:
+                        return None
+                return True
+
+            _poll(both_mid_flight, deadline_s=120,
+                  what="both tenants' ledgers mid-flight")
+            journaled = {
+                tenant: len(ledgermod.load(ledgermod.job_path(
+                    job, str(staging / "ledger")))[1])
+                for tenant, (job, _n) in jobs.items()}
+        proc.kill()  # SIGKILL — the hardest daemon loss there is
+        proc.wait(timeout=30)
+        assert proc.returncode == -9
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    # orphaned pool workers notice the dead daemon and exit
+    time.sleep(1.0)
+
+    proc2, port2 = _spawn_daemon(str(tmp_path / "port2"), env)
+    try:
+        with ServeClient(("127.0.0.1", port2)) as client:
+            for tenant, (job, n) in jobs.items():
+                view = client.wait(job, timeout=180)
+                assert view["state"] == protocol.DONE, (tenant, view)
+                assert view["replayed"] is True
+                assert client.results(job) == list(range(n))
+
+            # exactly-once per tenant: journaled chunks restored (not
+            # re-executed), remainder executed, nothing lost. Cost
+            # records are eventually consistent -> retry-poll.
+            def reconciled():
+                out = {}
+                for tenant, (job, n) in jobs.items():
+                    rec = accounting.read_job_record(
+                        job, directory=str(staging / "costs"))
+                    total = (rec or {}).get("total") or {}
+                    tasks = int(total.get("tasks", 0))
+                    restored = int(total.get("tasks_restored", 0))
+                    if not restored or tasks + restored != n:
+                        return None
+                    out[tenant] = restored
+                return out
+
+            restored = _poll(reconciled, deadline_s=60,
+                             what="exactly-once cost records")
+            for tenant, (job, _n) in jobs.items():
+                # chunks kept journaling between our snapshot and the
+                # SIGKILL, so restored-at-replay is a floor, not exact
+                assert restored[tenant] >= 2 * journaled[tenant], (
+                    tenant, restored, journaled)
+            client.shutdown()
+        rc = proc2.wait(timeout=60)
+        assert rc == 0, rc
+    finally:
+        if proc2.poll() is None:
+            proc2.kill()
+            proc2.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# chaos arm (make chaos): client SIGKILL'd AND a worker chaos-killed,
+# both mid-job, one daemon
+# ---------------------------------------------------------------------------
+
+
+_VICTIM_CLIENT = """\
+import sys
+from fiber_tpu.serve.client import ServeClient
+from tests import targets
+
+port, job, n = int(sys.argv[1]), sys.argv[2], int(sys.argv[3])
+c = ServeClient(("127.0.0.1", port))
+c.submit(targets.sleep_echo, list(range(n)), tenant="victim",
+         job_id=job, chunksize=2)
+c.wait(job)
+"""
+
+
+@pytest.mark.slow
+def test_chaos_serve_client_and_worker_killed_mid_job(tmp_path):
+    """Seeded serve-mode chaos drill: ONE daemon takes a job whose
+    submitting client is SIGKILL'd mid-flight while the chaos plan
+    (inherited by the daemon through the env) hard-kills one of the
+    daemon's pool workers mid-chunk. Neither loss may cost a task: a
+    fresh client polls the job to DONE with full ordered results, and
+    the cluster-wide kill-token budget proves the worker fault actually
+    fired inside the daemon's tree."""
+    from fiber_tpu.testing import chaos
+
+    seed = int(os.environ.get("FIBER_CHAOS_SEED", "7"))
+    plan = chaos.install(chaos.ChaosPlan(
+        seed=seed, token_dir=str(tmp_path / "tokens"),
+        kill_after_chunks=2, kill_times=1))
+    staging = tmp_path / "staging"
+    env = dict(
+        os.environ,  # carries the installed chaos plan to the daemon
+        FIBER_BACKEND="local",
+        FIBER_AGENT_STAGING=str(staging),
+        PYTHONPATH=REPO_ROOT,
+        FIBER_SERVE_PROCESSES="2",
+        FIBER_SERVE_WARM_FLOOR="2",
+        FIBER_SERVE_TICK_S="0.1",
+    )
+    job, n = _unique_job("chaos-victim"), 60
+    proc = vic = None
+    try:
+        proc, port = _spawn_daemon(str(tmp_path / "port"), env)
+        vic = subprocess.Popen(
+            [sys.executable, "-c", _VICTIM_CLIENT, str(port), job,
+             str(n)], env=env, cwd=REPO_ROOT)
+        _poll(lambda: (os.path.exists(
+            ledgermod.job_path(job, str(staging / "ledger")))
+            and len(ledgermod.load(ledgermod.job_path(
+                job, str(staging / "ledger")))[1]) >= 2) or None,
+            deadline_s=120, what="victim job mid-flight")
+        vic.kill()
+        vic.wait(timeout=30)
+        assert vic.returncode == -9
+        with ServeClient(("127.0.0.1", port)) as client:
+            view = client.wait(job, timeout=180)
+            assert view["state"] == protocol.DONE, view
+            assert client.results(job) == list(range(n))
+            assert plan.spent("kill") == 1  # the worker fault DID fire
+            client.shutdown()
+        assert proc.wait(timeout=60) == 0
+    finally:
+        chaos.uninstall()
+        for p in (vic, proc):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# CLI: fiber-tpu jobs --tenant
+# ---------------------------------------------------------------------------
+
+
+def test_cli_jobs_tenant_filter(capsys):
+    from fiber_tpu import cli
+
+    job = _unique_job("clitenant")
+    with fiber_tpu.Pool(2) as pool:
+        assert pool.map(targets.square, range(6), chunksize=2,
+                        job_id=job, tenant="acme") == \
+            [i * i for i in range(6)]
+
+    def shown():
+        capsys.readouterr()
+        assert cli.main(["jobs", "--tenant", "acme"]) == 0
+        out = capsys.readouterr().out
+        return out if job in out else None
+
+    deadline = time.monotonic() + 30
+    out = None
+    while time.monotonic() < deadline and out is None:
+        out = shown()  # the cost record lands asynchronously
+        time.sleep(0.1)
+    assert out is not None, "job never showed under --tenant acme"
+    line = [ln for ln in out.splitlines() if job in ln][0]
+    assert "tenant=acme" in line and "done" in line
+    # a different tenant filter hides it
+    assert cli.main(["jobs", "--tenant", "nobody"]) == 0
+    out = capsys.readouterr().out
+    assert job not in out
+
+
+# ---------------------------------------------------------------------------
+# lint guard: orphaned __pycache__ entries
+# ---------------------------------------------------------------------------
+
+
+def test_check_pycache_flags_orphans(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+    try:
+        import check_pycache
+    finally:
+        sys.path.pop(0)
+    pkg = tmp_path / "pkg"
+    cache = pkg / "__pycache__"
+    cache.mkdir(parents=True)
+    (pkg / "alive.py").write_text("x = 1\n")
+    (cache / "alive.cpython-311.pyc").write_bytes(b"\x00")
+    assert check_pycache.main([str(tmp_path)]) == 0
+    # the orphan: compiled file whose source is gone
+    (cache / "ghost.cpython-311.pyc").write_bytes(b"\x00")
+    assert check_pycache.main([str(tmp_path)]) == 1
+    assert "ghost" in capsys.readouterr().err
+    # the repo itself must be clean (the make lint gate)
+    assert check_pycache.main(
+        [os.path.join(REPO_ROOT, "fiber_tpu"),
+         os.path.join(REPO_ROOT, "tests")]) == 0

@@ -6,7 +6,8 @@ PYTEST ?= python -m pytest tests/ -q
 .PHONY: test stest test-all lint bench bench-store bench-telemetry \
 	bench-sched bench-transport bench-cluster bench-recovery \
 	bench-accounting bench-check bench-scale bench-ici \
-	bench-autonomy bench-stream bench-serve weakscale docs chaos
+	bench-autonomy bench-stream bench-serve bench-slo weakscale docs \
+	chaos
 
 # Tier 1: local backend (subprocess jobs)
 test:
@@ -138,6 +139,18 @@ bench-serve:
 	JAX_PLATFORMS=cpu python bench.py --serve --record > BENCH_serve.json; \
 	rc=$$?; cat BENCH_serve.json; exit $$rc
 
+# SLO plane + observability archive gate (docs/observability.md "SLOs
+# and the archive"): FAILS when running the serve workload with the
+# archive + SLO plane armed costs more than 1.05x the plain daemon,
+# when injected slow-worker chaos does not breach `slo_burn` with a
+# complete cause_id-linked anomaly -> policy action -> outcome chain
+# in the archive, when a SIGKILL'd + restarted daemon loses its burn-
+# window state (archive replay), or when `history` queries return any
+# torn record. The record lands in BENCH_slo.json either way.
+bench-slo:
+	JAX_PLATFORMS=cpu python bench.py --slo --record > BENCH_slo.json; \
+	rc=$$?; cat BENCH_slo.json; exit $$rc
+
 # Streaming data plane gate (docs/streaming.md): a million tiny tasks
 # through a windowed imap_unordered over a generator — nothing
 # materialized anywhere. FAILS when the run completes < 1M tasks, when
@@ -194,6 +207,7 @@ weakscale:
 lint:
 	python -m compileall -q fiber_tpu examples bench.py __graft_entry__.py
 	python scripts/check_pycache.py fiber_tpu examples tests scripts
+	python scripts/check_docs_nav.py
 
 # Docs site (reference parity: built mkdocs site). Prefers mkdocs when
 # installed; otherwise the zero-dependency renderer (same mkdocs.yml nav).

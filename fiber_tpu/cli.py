@@ -491,25 +491,38 @@ def cmd_status(args) -> int:
     from fiber_tpu.backends.tpu import AgentClient
 
     rc = 0
+    rows = []
     for host, port in _resolve_cli_hosts(args):
+        row = {"host": host, "port": port, "up": False}
         try:
             info, jobs = _probe_agent(host, port)
-            print(f"{host}:{port}  up  cpus={info['cpu_count']} "
-                  f"live_jobs={len(jobs)} python={info['python']}")
+            row.update(up=True, cpus=info["cpu_count"],
+                       live_jobs=len(jobs), python=info["python"])
+            if not args.json:
+                print(f"{host}:{port}  up  cpus={info['cpu_count']} "
+                      f"live_jobs={len(jobs)} python={info['python']}")
         except Exception as err:
-            print(f"{host}:{port}  DOWN  ({err})")
+            row["error"] = repr(err)
+            if not args.json:
+                print(f"{host}:{port}  DOWN  ({err})")
             rc = 1
+            rows.append(row)
             continue
         # Scheduler snapshot (best-effort: pre-sched agents and masters
         # without pools simply have none to show).
         client = AgentClient(host, port)
         try:
             snap = client.call("telemetry_snapshot")
-            _render_sched(snap.get("sched"), indent="    ")
+            row["sched"] = snap.get("sched") or []
+            if not args.json:
+                _render_sched(snap.get("sched"), indent="    ")
         except Exception:  # noqa: BLE001
             pass
         finally:
             client.close()
+        rows.append(row)
+    if args.json:
+        print(json.dumps(rows, default=str))
     return rc
 
 
@@ -924,6 +937,30 @@ def cmd_top(args) -> int:
                               f"/{warm.get('floor')}-{warm.get('ceiling')} "
                               f"denied={sum((adm.get('denied') or {}).values())} "
                               f"preempted={adm.get('preempted_maps', 0)}")
+                        # SLO/burn + archive columns (PR-18 surface):
+                        # aggregate error rate / p95 / worst fast-window
+                        # burn, and the durable archive's size — older
+                        # daemons without the fields just skip the row.
+                        slo = st.get("slo") or {}
+                        arch = st.get("archive") or {}
+                        if slo:
+                            err_rate = slo.get("error_rate")
+                            p95 = slo.get("latency_p95")
+                            burn = slo.get("max_burn")
+                            err_s = (f"{err_rate:.1%}"
+                                     if err_rate is not None else "-")
+                            p95_s = f"{p95}s" if p95 is not None else "-"
+                            burn_s = (f"{burn}x"
+                                      if burn is not None else "-")
+                            flag = ("BURN" if slo.get("breached")
+                                    else "ok")
+                            print(f"serve slo: {flag} "
+                                  f"jobs={slo.get('window_jobs', 0)} "
+                                  f"err={err_s} p95={p95_s} "
+                                  f"burn={burn_s}  "
+                                  f"archive[segs={arch.get('segments', 0)} "
+                                  f"{int(arch.get('bytes', 0)) >> 10}KB "
+                                  f"torn={arch.get('torn_lines', 0)}]")
                     except Exception as err:  # noqa: BLE001
                         print(f"serve: unreachable ({err!r})")
                         rc = 1
@@ -1636,12 +1673,17 @@ def cmd_jobs(args) -> int:
     from fiber_tpu.store import ledger as ledgermod
     from fiber_tpu.telemetry import accounting
 
+    as_json = bool(getattr(args, "json", False))
     jobs = ledgermod.list_jobs(args.ledger_dir or None)
     if not jobs:
-        print("no job ledgers under "
-              f"{args.ledger_dir or ledgermod.default_ledger_dir()}")
+        if as_json:
+            print("[]")
+        else:
+            print("no job ledgers under "
+                  f"{args.ledger_dir or ledgermod.default_ledger_dir()}")
         return 0
     shown = 0
+    rows = []
     for job in jobs:
         try:
             header, completed, done = ledgermod.load(
@@ -1658,6 +1700,15 @@ def cmd_jobs(args) -> int:
         if want and tenant != want:
             continue
         n_items = int(header.get("n_items") or 0)
+        if as_json:
+            rows.append({
+                "job_id": job, "tenant": tenant, "tasks": n_items,
+                "journaled_chunks": len(completed), "done": done,
+                "cost": (record or {}).get("total"),
+                "ts": (record or {}).get("ts"),
+            })
+            shown += 1
+            continue
         line = (f"{job}  tenant={tenant or '-'} tasks={n_items} "
                 f"journaled_chunks={len(completed)} "
                 f"{'done' if done else 'RESUMABLE'}")
@@ -1669,7 +1720,9 @@ def cmd_jobs(args) -> int:
                      f"+{int(total.get('tasks_restored', 0))}r")
         print(line)
         shown += 1
-    if not shown and getattr(args, "tenant", ""):
+    if as_json:
+        print(json.dumps(rows, default=str))
+    elif not shown and getattr(args, "tenant", ""):
         print(f"no jobs billed to tenant {args.tenant!r}")
     return 0
 
@@ -1781,6 +1834,103 @@ def cmd_cancel(args) -> int:
         raise SystemExit(f"error: {err}") from None
     finally:
         client.close()
+
+
+def cmd_slo(args) -> int:
+    """Per-tenant SLO report from a serve daemon (docs/observability.md
+    "SLOs and the archive"): SLI percentiles from the fixed-bucket
+    histograms, error rates, and the fast/slow burn rates each armed
+    objective is running at."""
+    from fiber_tpu.serve.client import ServeClient, ServeError
+
+    client = ServeClient(_serve_address(args.serve))
+    try:
+        snap = client.slo(args.tenant or None)
+    except (ServeError, OSError, EOFError) as err:
+        raise SystemExit(f"error: {err}") from None
+    finally:
+        client.close()
+    if args.json:
+        print(json.dumps(snap, default=str))
+        return 0
+    t = snap.get("targets") or {}
+    objectives = [f"{name}<={t[key]}s" for name, key in
+                  (("latency", "latency_s"), ("queue", "queue_s"))
+                  if t.get(key)]  # unset objective: no target, no column
+    print(f"targets: {' '.join(objectives) or '(none)'} p={t.get('p')} "
+          f"error_budget={t.get('error_pct', 0):.2%} "
+          f"burn>={t.get('burn_threshold')}x "
+          f"windows={t.get('fast_window_s'):.0f}s/"
+          f"{t.get('window_s'):.0f}s")
+    print(f"state: {'BURNING' if snap.get('breached') else 'ok'} "
+          f"({snap.get('window_jobs', 0)} job(s) in window, "
+          f"{snap.get('observations', 0)} observed)")
+    tenants = snap.get("tenants") or {}
+    if not tenants:
+        print("no observations yet")
+        return 0
+    print(f"{'tenant':<16} {'jobs':>5} {'err%':>6} {'q_p95':>7} "
+          f"{'lat_p50':>8} {'lat_p95':>8} {'tasks':>7}  burn")
+    for name in sorted(tenants):
+        ten = tenants[name]
+        jobs_n = sum((ten.get("jobs") or {}).values())
+        lat = ten.get("latency") or {}
+        q = ten.get("queue_wait") or {}
+        burns = []
+        for obj, b in sorted((ten.get("burn") or {}).items()):
+            bf = b.get("burn_fast")
+            if bf is not None:
+                burns.append(f"{obj}={bf:g}x")
+        fmt = lambda v, suf="s": f"{v:g}{suf}" if v is not None else "-"
+        print(f"{name:<16} {jobs_n:>5} "
+              f"{ten.get('error_rate', 0.0):>6.1%} "
+              f"{fmt(q.get('p95')):>7} {fmt(lat.get('p50')):>8} "
+              f"{fmt(lat.get('p95')):>8} {ten.get('tasks', 0):>7}  "
+              + (" ".join(burns) or "-"))
+    return 1 if snap.get("breached") else 0
+
+
+def cmd_history(args) -> int:
+    """Query a serve daemon's persistent observability archive
+    (docs/observability.md "SLOs and the archive"): time-range records
+    of one metric — a sample field (``tasks_per_s``), or a record kind
+    (``event`` / ``slo_obs`` / ``cost`` / ``sample``) — optionally
+    label-filtered (``--label rule=slo_burn``)."""
+    from fiber_tpu.serve.client import ServeClient, ServeError
+
+    labels = {}
+    for item in args.label or []:
+        if "=" not in item:
+            raise SystemExit(
+                f"error: --label wants key=value, got {item!r}")
+        k, _, v = item.partition("=")
+        labels[k] = v
+    now = time.time()
+    since = now - args.since if args.since else None
+    until = now - args.until if args.until else None
+    client = ServeClient(_serve_address(args.serve))
+    try:
+        records = client.query(args.metric, since=since, until=until,
+                               labels=labels or None, limit=args.limit)
+    except (ServeError, OSError, EOFError) as err:
+        raise SystemExit(f"error: {err}") from None
+    finally:
+        client.close()
+    if args.json:
+        print(json.dumps(records, default=str))
+        return 0
+    for rec in records:
+        stamp = time.strftime("%H:%M:%S",
+                              time.localtime(float(rec.get("ts") or 0)))
+        if set(rec) == {"ts", "value"}:
+            print(f"[{stamp}] {rec['value']}")
+            continue
+        rest = " ".join(f"{k}={v}" for k, v in sorted(rec.items())
+                        if k not in ("ts", "kind"))
+        print(f"[{stamp}] {rec.get('kind')} {rest}")
+    if not records:
+        print(f"no {args.metric!r} records in range", file=sys.stderr)
+    return 0
 
 
 def cmd_logs(args) -> int:
@@ -1910,6 +2060,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=0,
                    help="port for portless --hosts entries / derived "
                         "addresses")
+    p.add_argument("--json", action="store_true",
+                   help="print the per-host rows as a JSON list")
     p.set_defaults(fn=cmd_status)
 
     p = sub.add_parser("metrics",
@@ -2140,6 +2292,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tenant", default="",
                    help="only jobs billed to this tenant (from the "
                         "persisted per-job cost records)")
+    p.add_argument("--json", action="store_true",
+                   help="print the job rows as a JSON list")
     p.set_defaults(fn=cmd_jobs)
 
     p = sub.add_parser(
@@ -2190,6 +2344,40 @@ def build_parser() -> argparse.ArgumentParser:
                    help="daemon address host:port (default "
                         "127.0.0.1:<serve_port>)")
     p.set_defaults(fn=cmd_cancel)
+
+    p = sub.add_parser(
+        "slo", help="per-tenant SLO report from a serve daemon "
+                    "(exit 1 while an objective is burning)")
+    p.add_argument("--tenant", default="",
+                   help="report just this tenant")
+    p.add_argument("--serve", default="",
+                   help="daemon address host:port (default "
+                        "127.0.0.1:<serve_port>)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw snapshot as JSON")
+    p.set_defaults(fn=cmd_slo)
+
+    p = sub.add_parser(
+        "history", help="query the serve daemon's observability "
+                        "archive for one metric's time range")
+    p.add_argument("metric",
+                   help="sample field (tasks_per_s) or record kind "
+                        "(event / slo_obs / cost / sample)")
+    p.add_argument("--since", type=float, default=0.0,
+                   help="seconds ago to start the range (0 = all "
+                        "retained history)")
+    p.add_argument("--until", type=float, default=0.0,
+                   help="seconds ago to end the range (0 = now)")
+    p.add_argument("--label", action="append", default=[],
+                   help="key=value record filter, repeatable "
+                        "(e.g. --label rule=slo_burn)")
+    p.add_argument("--limit", type=int, default=1000)
+    p.add_argument("--serve", default="",
+                   help="daemon address host:port (default "
+                        "127.0.0.1:<serve_port>)")
+    p.add_argument("--json", action="store_true",
+                   help="print the records as JSON")
+    p.set_defaults(fn=cmd_history)
 
     p = sub.add_parser("logs", help="fetch a job's log tail by jid")
     p.add_argument("jid", help="host:port/jobid (as printed by --submit)")

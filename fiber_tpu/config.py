@@ -325,6 +325,44 @@ DEFAULTS: Dict[str, Any] = {
     # Serve-tier job journal directory. "" = <staging root>/serve,
     # beside ledger/ and costs/.
     "serve_dir": "",
+    # --- observability archive (docs/observability.md "SLOs and the
+    # archive") ---
+    # Persist monitor samples, flight/anomaly/policy events and per-job
+    # cost/SLO observations into time-partitioned segment files each
+    # sampler tick. Off by default: the serve daemon arms it
+    # process-locally on startup (ARCHIVE.enable()), so pool workers
+    # never inherit an archive writer through config adoption; set True
+    # to archive any process.
+    "archive_enabled": False,
+    # Archive directory. "" = <staging root>/archive, beside ledger/,
+    # costs/ and serve/.
+    "archive_dir": "",
+    # Segment roll interval, seconds: one file per window keeps
+    # time-range queries from scanning the whole history.
+    "archive_segment_s": 300.0,
+    # Longest interval, seconds, an accepted record may sit in the OS
+    # page cache before fsync (the ledger_fsync_s posture: batched
+    # durability, bounded loss window).
+    "archive_fsync_s": 0.2,
+    # Retention horizon, seconds: segments whose window ended earlier
+    # are pruned on roll.
+    "archive_retention_s": 604800.0,
+    # Size cap, MB: oldest segments pruned first once the archive
+    # exceeds it.
+    "archive_max_mb": 256,
+    # --- per-tenant SLOs (serve daemon; docs/observability.md) ---
+    # Declarative targets over the serve tier's per-tenant SLIs. A
+    # latency/queue target of 0 disables that objective; the error-rate
+    # objective is always on (its budget is serve_slo_error_pct). The
+    # burn-rate evaluation is multi-window: `slo_burn` raises only when
+    # BOTH the fast and the slow window burn past serve_slo_burn.
+    "serve_slo_latency_s": 0.0,    # submit->done latency target, seconds
+    "serve_slo_queue_s": 0.0,      # queue-wait target, seconds
+    "serve_slo_p": 0.95,           # percentile the latency targets bound
+    "serve_slo_error_pct": 0.01,   # error budget: allowed bad-job fraction
+    "serve_slo_window_s": 3600.0,  # slow burn window, seconds
+    "serve_slo_fast_window_s": 300.0,  # fast burn window, seconds
+    "serve_slo_burn": 2.0,         # burn-rate threshold (both windows)
     # --- TPU backend ---
     "tpu_name": "",
     "tpu_zone": "",

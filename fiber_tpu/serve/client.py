@@ -145,5 +145,21 @@ class ServeClient:
     def jobs(self, tenant: Optional[str] = None) -> List[Dict[str, Any]]:
         return self._call("jobs", tenant=tenant)
 
+    def query(self, metric: str, since: Optional[float] = None,
+              until: Optional[float] = None,
+              labels: Optional[Dict[str, Any]] = None,
+              limit: int = 1000) -> List[Dict[str, Any]]:
+        """Archive time-range query: records of ``metric`` (a kind like
+        ``"event"``/``"slo_obs"`` or a sample field like
+        ``"tasks_per_s"``) in ``[since, until]`` epoch seconds, oldest
+        first (docs/observability.md "SLOs and the archive")."""
+        return self._call("query", metric=metric, since=since,
+                          until=until, labels=labels, limit=limit)
+
+    def slo(self, tenant: Optional[str] = None) -> Dict[str, Any]:
+        """Per-tenant SLI/SLO snapshot (targets, histograms, burn
+        rates, breach state)."""
+        return self._call("slo", tenant=tenant)
+
     def shutdown(self) -> str:
         return self._call("shutdown")

@@ -83,6 +83,8 @@ class ServeDaemon:
         self._stop = threading.Event()
         self._started = time.time()
         self._tick_thread: Optional[threading.Thread] = None
+        self._metrics_port = int(cfg.metrics_port)
+        self._metrics_server = None
 
     # -- lifecycle ------------------------------------------------------
     def start_background(self) -> None:
@@ -95,6 +97,37 @@ class ServeDaemon:
                          daemon=True).start()
 
     def startup(self) -> None:
+        # Observability plane first: arm the archive writer for THIS
+        # process (workers spawned below must not inherit it via
+        # config), replay the archive tail so the SLO burn windows
+        # survive a SIGKILL, and register the warm pool as the
+        # slo_burn policy's boost target.
+        from fiber_tpu import config as _config
+        from fiber_tpu.telemetry.archive import ARCHIVE
+        from fiber_tpu.telemetry.policy import register_warm_pool
+        from fiber_tpu.telemetry.slo import SLO
+
+        if _config.get().telemetry_enabled:
+            ARCHIVE.enable(local=True)
+            restored = SLO.replay()
+            if restored:
+                logger.info("serve: restored %d SLO observation(s) "
+                            "from the archive", restored)
+        register_warm_pool(self.warm)
+        # Live Prometheus exposition beside the durable archive: one
+        # daemon endpoint for both (metrics_port knob; 0 = off).
+        if self._metrics_port:
+            from fiber_tpu import telemetry
+
+            try:
+                self._metrics_server = telemetry.serve_metrics(
+                    port=self._metrics_port, bind=self._bind)
+                logger.info("serve: metrics endpoint on %s:%d",
+                            self._bind, self._metrics_server.port)
+            except Exception:  # noqa: BLE001 - exposition is optional;
+                # the daemon serves without it
+                logger.warning("serve: metrics endpoint failed to "
+                               "start", exc_info=True)
         replayed = self.runner.replay()
         if replayed:
             logger.info("serve: replayed %d in-flight job(s): %s",
@@ -129,12 +162,26 @@ class ServeDaemon:
             socket.create_connection((host, self.port), 0.5).close()
         except OSError:
             pass
+        if self._metrics_server is not None:
+            try:
+                self._metrics_server.stop()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                pass
+            self._metrics_server = None
         try:
             self.runner.close(terminate=terminate_pool)
         except Exception:  # noqa: BLE001 - teardown is best-effort
             logger.warning("serve: pool teardown failed", exc_info=True)
+        # Disarm the archive writer last: the pool teardown above still
+        # emits flight events worth keeping, and a stopped daemon's
+        # process (tests embed one) must not keep writing segments.
+        from fiber_tpu.telemetry.archive import ARCHIVE
+
+        ARCHIVE.disable()
 
     def _tick_loop(self) -> None:
+        from fiber_tpu.telemetry.slo import SLO
+
         while not self._stop.is_set():
             try:
                 self.admission.tick()
@@ -144,6 +191,15 @@ class ServeDaemon:
                 self.warm.tick()
             except Exception:  # noqa: BLE001
                 logger.exception("serve: warm-pool tick failed")
+            # SLO sweep: fold newly terminal jobs into the per-tenant
+            # SLIs (each observation lands in the archive the moment it
+            # is taken), then evaluate the multi-window burn rates —
+            # the slo_burn raise/refresh/clear edge.
+            try:
+                SLO.observe_jobs(self.runner.terminal_views())
+                SLO.evaluate()
+            except Exception:  # noqa: BLE001
+                logger.exception("serve: slo tick failed")
             self._stop.wait(self._tick_s)
 
     # -- RPC dispatch ---------------------------------------------------
@@ -159,6 +215,9 @@ class ServeDaemon:
         return "pong"
 
     def _op_status(self) -> Dict[str, Any]:
+        from fiber_tpu.telemetry.archive import ARCHIVE
+        from fiber_tpu.telemetry.slo import SLO
+
         pool = self.runner._pool
         return {
             "protocol": protocol.PROTOCOL_VERSION,
@@ -169,6 +228,32 @@ class ServeDaemon:
             "warm_pool": self.warm.stats(),
             "admission": self.admission.stats(),
             "pool_alive": pool is not None and not pool._terminated,
+            "slo": self._slo_summary(SLO),
+            "archive": ARCHIVE.stats(),
+            "metrics_port": (self._metrics_server.port
+                             if self._metrics_server is not None
+                             else None),
+        }
+
+    @staticmethod
+    def _slo_summary(slo) -> Dict[str, Any]:
+        """Compact SLO row for status (`fiber-tpu top --serve`
+        columns); the full per-tenant surface is the `slo` verb."""
+        snap = slo.snapshot()
+        agg = snap["tenants"].get("*", {})
+        burns = [b.get("burn_fast")
+                 for objs in (t.get("burn", {})
+                              for t in snap["tenants"].values())
+                 for b in objs.values()
+                 if isinstance(b, dict)
+                 and isinstance(b.get("burn_fast"), (int, float))]
+        return {
+            "breached": snap["breached"],
+            "observations": snap["observations"],
+            "window_jobs": snap["window_jobs"],
+            "error_rate": agg.get("error_rate"),
+            "latency_p95": (agg.get("latency") or {}).get("p95"),
+            "max_burn": max(burns) if burns else None,
         }
 
     def _op_submit(self, tenant: str, job_id: str, func: bytes,
@@ -197,6 +282,24 @@ class ServeDaemon:
 
     def _op_jobs(self, tenant: Optional[str] = None) -> list:
         return self.runner.jobs(tenant)
+
+    def _op_query(self, metric: str, since: Optional[float] = None,
+                  until: Optional[float] = None,
+                  labels: Optional[Dict[str, Any]] = None,
+                  limit: int = 1000) -> list:
+        """Archive time-range query (`fiber-tpu history`)."""
+        from fiber_tpu.telemetry.archive import ARCHIVE
+
+        return ARCHIVE.query(str(metric), since=since, until=until,
+                             labels=labels, limit=int(limit))
+
+    def _op_slo(self, tenant: Optional[str] = None) -> Dict[str, Any]:
+        """Per-tenant SLI/SLO snapshot (`fiber-tpu slo`)."""
+        from fiber_tpu.telemetry.slo import SLO
+
+        if tenant is not None:
+            protocol.check_tenant(tenant)
+        return SLO.snapshot(tenant)
 
     def _op_shutdown(self) -> str:
         # Reply first, stop a beat later: the serve loop would turn a

@@ -51,8 +51,9 @@ class Job:
     ``view()`` dict is what crosses the wire."""
 
     __slots__ = ("tenant", "job_id", "state", "n_items", "star",
-                 "chunksize", "submitted_at", "finished_at", "error",
-                 "results", "cancel_requested", "replayed")
+                 "chunksize", "submitted_at", "started_at",
+                 "finished_at", "error", "results", "cancel_requested",
+                 "replayed")
 
     def __init__(self, tenant: str, job_id: str, n_items: int,
                  star: bool, chunksize: Optional[int]) -> None:
@@ -63,6 +64,7 @@ class Job:
         self.star = bool(star)
         self.chunksize = chunksize
         self.submitted_at = time.time()
+        self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.error: Optional[str] = None
         self.results: Optional[List[Any]] = None
@@ -74,6 +76,7 @@ class Job:
             "tenant": self.tenant, "job_id": self.job_id,
             "state": self.state, "n_items": self.n_items,
             "submitted_at": self.submitted_at,
+            "started_at": self.started_at,
             "finished_at": self.finished_at, "error": self.error,
             "replayed": self.replayed,
         }
@@ -189,6 +192,9 @@ class JobRunner:
         with self._lock:
             if job.state == protocol.QUEUED:
                 job.state = protocol.RUNNING
+                # Queue-wait SLI stamp (telemetry/slo.py): dispatch
+                # admission is done, chunks are the scheduler's now.
+                job.started_at = time.time()
         self._journal(job)
         return job.view()
 
@@ -246,6 +252,15 @@ class JobRunner:
         out.sort(key=lambda r: r.get("submitted_at") or 0.0,
                  reverse=True)
         return out
+
+    def terminal_views(self) -> List[Dict[str, Any]]:
+        """Views of every in-memory job in a terminal state (the SLO
+        plane's per-tick observation feed — memory only, no journal
+        I/O; pre-restart jobs re-enter the SLIs via the archive replay
+        instead)."""
+        with self._lock:
+            return [j.view() for j in self._jobs.values()
+                    if j.state in protocol.TERMINAL_STATES]
 
     def counts(self) -> Dict[str, int]:
         with self._lock:

@@ -32,6 +32,8 @@ VERBS = (
     "results",    # completed job's results -> serialized list
     "cancel",     # stop a running job (parked resumable)
     "jobs",       # list jobs, optional tenant filter
+    "query",      # archive time-range query (fiber-tpu history)
+    "slo",        # per-tenant SLI/SLO snapshot (fiber-tpu slo)
     "shutdown",   # stop serving (admin)
 )
 

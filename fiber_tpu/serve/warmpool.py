@@ -38,6 +38,9 @@ class WarmPool:
         self._lock = threading.Lock()
         self.scale_ups = 0
         self.scale_downs = 0
+        # slo_burn boost state: the pre-boost floor, None when not
+        # boosted (telemetry/policy.py's warm-pool lever).
+        self._boost_floor: Optional[int] = None
 
     @classmethod
     def from_config(cls, runner, cfg) -> "WarmPool":
@@ -100,6 +103,44 @@ class WarmPool:
                     "serve: warm pool idle %.1fs — scale-down %d -> %d "
                     "workers (floor)", self.idle_s, current, self.floor)
 
+    # -- policy-plane levers (slo_burn; telemetry/policy.py) -----------
+    def boost(self) -> bool:
+        """Raise the floor to the ceiling so every tick holds the pool
+        fully scaled while a tenant's SLO burns (queue-wait burn is
+        capacity-shaped). Idempotent; False when already boosted or
+        there is no headroom. The clear-edge revert is unboost()."""
+        pool = self._runner._pool
+        if pool is None or pool._closed or pool._terminated:
+            return False
+        ceiling = self._ceiling(pool)
+        with self._lock:
+            if self._boost_floor is not None or ceiling <= self.floor:
+                return False
+            self._boost_floor = self.floor
+            self.floor = ceiling
+        try:
+            pool.resize(ceiling)
+            self.scale_ups += 1
+        except Exception:  # noqa: BLE001 - the raised floor still
+            # holds; the next tick retries the resize
+            logger.warning("serve: warm-pool boost resize failed",
+                           exc_info=True)
+        logger.info("serve: warm pool boosted to ceiling (%d workers) "
+                    "while slo_burn stands", ceiling)
+        return True
+
+    def unboost(self) -> bool:
+        """Restore the pre-boost floor (the normal idle scale-down
+        brings the workers back down)."""
+        with self._lock:
+            if self._boost_floor is None:
+                return False
+            self.floor = self._boost_floor
+            self._boost_floor = None
+        logger.info("serve: warm pool boost lifted (floor back to %d)",
+                    self.floor)
+        return True
+
     def stats(self) -> Dict[str, object]:
         pool = self._runner._pool
         with self._lock:
@@ -111,4 +152,5 @@ class WarmPool:
                 "scale_ups": self.scale_ups,
                 "scale_downs": self.scale_downs,
                 "idle": self._idle_since is not None,
+                "boosted": self._boost_floor is not None,
             }

@@ -138,6 +138,16 @@ def refresh() -> None:
     from fiber_tpu.telemetry.policy import POLICY
 
     POLICY.configure(cfg)
+    # Persistent archive + SLO plane (docs/observability.md "SLOs and
+    # the archive"): the archive flushes each sampler tick through its
+    # observer hook (near-zero when disarmed); the SLO tracker is
+    # driven by the serve daemon's tick. Lazy imports, same posture.
+    from fiber_tpu.telemetry.archive import ARCHIVE
+    from fiber_tpu.telemetry.slo import SLO
+
+    ARCHIVE.configure(cfg)
+    TIMESERIES.add_observer(ARCHIVE.on_sample)
+    SLO.configure(cfg)
 
 
 def snapshot() -> Dict[str, Any]:

@@ -1,0 +1,424 @@
+"""Persistent observability archive (docs/observability.md "SLOs and
+the archive").
+
+Every observability plane built so far — metrics registry, timeseries
+rings, flight recorder, anomaly/policy chains, cost vectors — is
+per-process and in-memory: a daemon restart erases all history, and
+``fiber-tpu top`` can only show the live instant. The archive is the
+durable layer under them: an append-only, time-partitioned store of
+JSON-line records under ``<staging>/archive/``, flushed on the monitor
+sampler tick (daemon-side) and queryable by time range + label.
+
+On-disk layout, one file per ``archive_segment_s`` window::
+
+    <archive_dir>/seg-<t0>-<pid>.jsonl
+        {"kind": "header", "v": 1, "t0": ..., "pid": ...}
+        {"kind": "sample", "ts": ..., "tasks_per_s": ..., ...}
+        {"kind": "event",  "ts": ..., "plane": "monitor", ...}
+        {"kind": "slo_obs", "ts": ..., "tenant": ..., ...}
+        {"kind": "cost",   "ts": ..., "job_id": ..., ...}
+
+Design posture, all inherited from the PR-7 ledger:
+
+* **Torn-tail tolerant** — a SIGKILL mid-write leaves at most one
+  partial final line per segment; readers skip unparseable lines (and
+  count them) instead of dying, so a query never returns a torn
+  record.
+* **Refuse-newer** — a segment whose header carries a larger
+  ``ARCHIVE_VERSION`` is skipped with a warning, never misparsed.
+* **Batched durability** — appends are buffered writes; fsync runs at
+  most every ``archive_fsync_s`` (bounded loss window, no per-record
+  syscall).
+* **Bounded** — on every segment roll, segments past
+  ``archive_retention_s`` are pruned, then oldest-first until the
+  archive fits ``archive_max_mb``.
+
+The writer is process-local and OFF by default: the serve daemon arms
+it on startup (:meth:`MetricsArchive.enable`), so the pool workers the
+daemon spawns never inherit an archive writer through config adoption.
+Segment filenames carry the writer's pid, so a restarted daemon (new
+pid) appends beside — never into — its predecessor's segments, and
+queries merge both.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from fiber_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+#: Bumped on any incompatible record-shape change; readers refuse
+#: (skip + warn) segments written by a NEWER version — same posture as
+#: the ledger's LEDGER_VERSION.
+ARCHIVE_VERSION = 1
+
+_SEG_RE = re.compile(r"^seg-(\d+)-(\d+)\.jsonl$")
+
+#: Hard cap on records one query returns (a runaway range must not
+#: build an unbounded reply for the serve protocol to pickle).
+QUERY_LIMIT = 10000
+
+
+def default_archive_dir() -> str:
+    """``archive_dir`` knob; "" puts it at ``<staging root>/archive``,
+    beside ``ledger/``, ``costs/`` and ``serve/``."""
+    from fiber_tpu import config as _config
+    from fiber_tpu.host_agent import default_staging_root
+
+    cfg_dir = str(_config.get().archive_dir or "")
+    return cfg_dir or os.path.join(default_staging_root(), "archive")
+
+
+class MetricsArchive:
+    """Append-only segment writer + time-range reader; see module
+    docstring. Thread-safe: appends come from the sampler tick and the
+    daemon tick thread, queries from per-connection RPC threads."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.enabled = False
+        self._dir: Optional[str] = None
+        self.segment_s = 300.0
+        self.fsync_s = 0.2
+        self.retention_s = 604800.0
+        self.max_bytes = 256 << 20
+        # live segment state (under _lock)
+        self._fh = None
+        self._seg_t0 = 0.0
+        self._last_fsync = 0.0
+        # flight-recorder drain watermark (lifetime accept count)
+        self._flight_mark = 0
+        # lifetime stats
+        self.records_written = 0
+        self.segments_rolled = 0
+        self.segments_pruned = 0
+        self.torn_lines = 0      # unparseable lines skipped by readers
+        self.refused_segments = 0  # newer-version segments skipped
+        # enable() came from code (the serve daemon), not the knob —
+        # configure() must not disarm it on the next refresh.
+        self._armed_locally = False
+
+    # -- configuration --------------------------------------------------
+    def configure(self, cfg) -> None:
+        """Re-read the archive knobs (telemetry.refresh). Arms the
+        writer only when the ``archive_enabled`` knob says so; the
+        serve daemon arms process-locally via :meth:`enable` instead."""
+        self.segment_s = max(1.0, float(cfg.archive_segment_s))
+        self.fsync_s = max(0.0, float(cfg.archive_fsync_s))
+        self.retention_s = max(1.0, float(cfg.archive_retention_s))
+        self.max_bytes = max(1, int(cfg.archive_max_mb)) << 20
+        want = bool(cfg.telemetry_enabled) and bool(cfg.archive_enabled)
+        if want and not self.enabled:
+            self.enable()
+        elif not want and self.enabled and not self._armed_locally:
+            self.disable()
+
+    def enable(self, directory: Optional[str] = None,
+               local: bool = False) -> None:
+        """Arm the writer for THIS process (the serve daemon's startup
+        call passes ``local=True``; the configure() path rides the
+        archive_enabled knob)."""
+        with self._lock:
+            self._dir = directory or default_archive_dir()
+            os.makedirs(self._dir, exist_ok=True)
+            self.enabled = True
+            if local:
+                self._armed_locally = True
+
+    def disable(self) -> None:
+        with self._lock:
+            self.enabled = False
+            self._armed_locally = False
+            self._close_segment_locked()
+
+    def directory(self) -> str:
+        return self._dir or default_archive_dir()
+
+    # -- write side -----------------------------------------------------
+    def append(self, kind: str, rec: Dict[str, Any]) -> bool:
+        """Append one record (stamped ``kind`` + ``ts`` when absent).
+        Near-zero when disabled: one attribute read + branch."""
+        if not self.enabled:
+            return False
+        rec = dict(rec)
+        rec["kind"] = kind
+        rec.setdefault("ts", time.time())
+        try:
+            line = json.dumps(rec, default=str)
+        except (TypeError, ValueError):
+            return False
+        now = time.time()
+        with self._lock:
+            if not self.enabled:  # disabled while we serialized
+                return False
+            try:
+                fh = self._segment_locked(now)
+                fh.write(line + "\n")
+                self.records_written += 1
+                if now - self._last_fsync >= self.fsync_s:
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                    self._last_fsync = now
+            except OSError:
+                logger.warning("archive: append failed", exc_info=True)
+                return False
+        return True
+
+    def on_sample(self, sample: Dict[str, Any]) -> None:
+        """Monitor-sampler observer (registered by telemetry.refresh):
+        persist the derived sample as one ``sample`` record, then drain
+        every flight event recorded since the last tick — anomaly
+        raise/clear, policy action/outcome, scheduler decisions — as
+        ``event`` records. One tick, one batch, one fsync window."""
+        if not self.enabled:
+            return
+        try:
+            numeric = {k: v for k, v in sample.items()
+                       if isinstance(v, (int, float))}
+            self.append("sample", numeric)
+            for ev in self._drain_flight():
+                self.append("event", ev)
+        except Exception:  # noqa: BLE001 - archiving must not take the
+            # sampler thread down
+            logger.warning("archive: sample flush failed", exc_info=True)
+
+    def _drain_flight(self) -> List[Dict[str, Any]]:
+        """New flight events since the last drain, identified by the
+        recorder's lifetime accept count (each event id is
+        ``"<pid>-<n>"``). Events evicted by the ring bound before a
+        tick are lost to the archive too — the recorder is the bound."""
+        from fiber_tpu.telemetry.flightrec import FLIGHT
+
+        mark = self._flight_mark
+        self._flight_mark = FLIGHT.recorded
+        if FLIGHT.recorded == mark:
+            return []
+        out = []
+        for ev in FLIGHT.snapshot():
+            try:
+                n = int(str(ev.get("id", "0-0")).rsplit("-", 1)[1])
+            except (ValueError, IndexError):
+                continue
+            if n <= mark:
+                continue
+            rec = {k: v for k, v in ev.items() if k != "kind"}
+            rec["event"] = ev.get("kind")
+            out.append(rec)
+        return out
+
+    def flush(self) -> None:
+        """Force the current segment durable (queries + tests +
+        daemon shutdown)."""
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.flush()
+                    os.fsync(self._fh.fileno())
+                    self._last_fsync = time.time()
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_segment_locked()
+
+    # -- segment lifecycle (under _lock) --------------------------------
+    def _segment_locked(self, now: float):
+        if self._fh is not None and now - self._seg_t0 < self.segment_s:
+            return self._fh
+        self._close_segment_locked()
+        self._seg_t0 = now
+        # Filenames carry whole-second t0; two rolls inside one second
+        # (sub-second segment_s in tests) must not merge into one file,
+        # so bump until unused.
+        base = int(now)
+        path = os.path.join(self.directory(),
+                            f"seg-{base}-{os.getpid()}.jsonl")
+        while os.path.exists(path):
+            base += 1
+            path = os.path.join(self.directory(),
+                                f"seg-{base}-{os.getpid()}.jsonl")
+        self._fh = open(path, "a")
+        self._fh.write(json.dumps(
+            {"kind": "header", "v": ARCHIVE_VERSION,
+             "t0": now, "pid": os.getpid()}) + "\n")
+        self.segments_rolled += 1
+        self._prune_locked(now)
+        return self._fh
+
+    def _close_segment_locked(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+    def _segments(self) -> List[Dict[str, Any]]:
+        """Every segment on disk, oldest first: ``{path, t0, pid,
+        bytes}``. Shared by pruning and queries; tolerant of foreign
+        files in the directory."""
+        out = []
+        try:
+            names = os.listdir(self.directory())
+        except OSError:
+            return []
+        for name in names:
+            m = _SEG_RE.match(name)
+            if not m:
+                continue
+            path = os.path.join(self.directory(), name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            out.append({"path": path, "t0": float(m.group(1)),
+                        "pid": int(m.group(2)), "bytes": st.st_size,
+                        "mtime": st.st_mtime})
+        out.sort(key=lambda s: s["t0"])
+        return out
+
+    def _prune_locked(self, now: float) -> None:
+        """Retention on roll: drop segments whose window ended past the
+        horizon, then oldest-first until under the size cap. The live
+        segment is never pruned."""
+        live = self._fh.name if self._fh is not None else None
+        segs = [s for s in self._segments() if s["path"] != live]
+        # Age by mtime (the newest record's append time): filename t0
+        # is whole-second and pins only the start of the window.
+        doomed = [s for s in segs
+                  if s["mtime"] < now - self.retention_s]
+        expired = {s["path"] for s in doomed}
+        keep = [s for s in segs if s["path"] not in expired]
+        total = sum(s["bytes"] for s in keep)
+        while keep and total > self.max_bytes:
+            victim = keep.pop(0)
+            doomed.append(victim)
+            total -= victim["bytes"]
+        for s in doomed:
+            try:
+                os.remove(s["path"])
+                self.segments_pruned += 1
+            except OSError:
+                pass
+
+    # -- read side ------------------------------------------------------
+    def query(self, metric: str, since: Optional[float] = None,
+              until: Optional[float] = None,
+              labels: Optional[Dict[str, Any]] = None,
+              limit: int = QUERY_LIMIT) -> List[Dict[str, Any]]:
+        """Records in ``[since, until]`` (epoch seconds; None = open)
+        matching ``metric``, oldest first.
+
+        ``metric`` is either a record kind (``"event"``, ``"slo_obs"``,
+        ``"cost"``, ``"sample"`` — full records returned) or a sample
+        field (``"tasks_per_s"`` — ``{"ts", "value"}`` points
+        returned). ``labels`` restricts to records whose fields equal
+        every given item (e.g. ``{"tenant": "alice"}`` or
+        ``{"rule": "slo_burn"}``). Torn lines are skipped and counted,
+        never returned."""
+        self.flush()
+        limit = max(1, min(int(limit), QUERY_LIMIT))
+        out: List[Dict[str, Any]] = []
+        for seg in self._segments():
+            # Segment-level skip is an optimization only: a record's ts
+            # may trail its append time (slo_obs carries finished_at),
+            # so allow one segment window of slack each way — the
+            # per-record ts filter in _scan is the source of truth.
+            if until is not None and seg["t0"] > until + self.segment_s:
+                continue
+            if since is not None and seg["mtime"] < since - self.segment_s:
+                continue
+            out.extend(self._scan(seg["path"], metric, since, until,
+                                  labels))
+            if len(out) >= limit:
+                break
+        out.sort(key=lambda r: float(r.get("ts") or 0.0))
+        return out[:limit]
+
+    def _scan(self, path: str, metric: str, since, until,
+              labels) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        try:
+            fh = open(path)
+        except OSError:
+            return out
+        with fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    # Torn tail (SIGKILL mid-write) or corruption:
+                    # skip, count, never die, never return it.
+                    self.torn_lines += 1
+                    continue
+                if not isinstance(rec, dict):
+                    self.torn_lines += 1
+                    continue
+                kind = rec.get("kind")
+                if kind == "header":
+                    if int(rec.get("v") or 0) > ARCHIVE_VERSION:
+                        self.refused_segments += 1
+                        logger.warning(
+                            "archive: segment %s written by a newer "
+                            "version (v%s > v%d); skipping it",
+                            os.path.basename(path), rec.get("v"),
+                            ARCHIVE_VERSION)
+                        break
+                    continue
+                ts = float(rec.get("ts") or 0.0)
+                if since is not None and ts < since:
+                    continue
+                if until is not None and ts > until:
+                    continue
+                if labels and any(rec.get(k) != v
+                                  for k, v in labels.items()):
+                    continue
+                if kind == metric:
+                    out.append(rec)
+                elif kind == "sample" and metric in rec:
+                    out.append({"ts": ts, "value": rec[metric]})
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        segs = self._segments()
+        return {
+            "enabled": self.enabled,
+            "dir": self.directory(),
+            "segments": len(segs),
+            "bytes": sum(s["bytes"] for s in segs),
+            "records_written": self.records_written,
+            "segments_rolled": self.segments_rolled,
+            "segments_pruned": self.segments_pruned,
+            "torn_lines": self.torn_lines,
+            "refused_segments": self.refused_segments,
+        }
+
+    def clear(self) -> None:
+        """Test isolation: close the live segment and reset counters
+        (on-disk segments are the test's tmp dir to manage)."""
+        with self._lock:
+            self._close_segment_locked()
+            self._flight_mark = 0
+            self.records_written = 0
+            self.segments_rolled = 0
+            self.segments_pruned = 0
+            self.torn_lines = 0
+            self.refused_segments = 0
+
+
+#: Process-wide archive; knobs follow telemetry.refresh(), the writer
+#: arms via the archive_enabled knob or the serve daemon's startup.
+ARCHIVE = MetricsArchive()

@@ -34,6 +34,11 @@ budget_exceeded     a running map crossed its ``CostBudget`` caps
                     (accounting plane; raised via
                     :meth:`AnomalyWatchdog.external_breach` at charge
                     time, not on the sampler tick)
+slo_burn            a serve-tier tenant's SLO is burning its error
+                    budget past ``serve_slo_burn`` in BOTH burn
+                    windows (SLO plane, telemetry/slo.py; raised via
+                    :meth:`AnomalyWatchdog.external_breach` from the
+                    daemon tick)
 ==================  ====================================================
 """
 

@@ -32,6 +32,12 @@ queue_growth          shrink the admission window of active streaming
                       maps (docs/streaming.md) so a runaway producer
                       parks instead of filling master RAM; restore the
                       original windows on clear
+slo_burn              a tenant's serve-tier SLO is burning its error
+                      budget (telemetry/slo.py): boost every registered
+                      warm pool to its ceiling (capacity is the lever
+                      for queue/latency burn) and, for an error burn,
+                      WDRR-throttle the offending tenant's in-flight
+                      maps; restore both on clear
 ====================  =================================================
 
 Verification closes the loop: ``policy_verify_s`` after an action the
@@ -87,6 +93,7 @@ RULE_SEVERITY: Dict[str, Tuple[str, int]] = {
     "hbm_fill": ("bytes", +1),
     "recompile_storm": ("count", +1),
     "budget_exceeded": ("observed", +1),
+    "slo_burn": ("burn", +1),
 }
 
 #: Fractional severity degradation that upgrades "persisted" to
@@ -103,6 +110,17 @@ def register_pool(pool) -> None:
     billing key to in-flight maps through every registered pool's
     ``throttle_billing_key`` hook."""
     _POOLS.add(pool)
+
+
+#: Warm pools registered for the slo_burn boost — weak, like _POOLS
+#: (a stopped daemon's warm pool drops out without bookkeeping).
+_WARM: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_warm_pool(warm) -> None:
+    """Called by the serve daemon: the slo_burn policy scales every
+    registered warm pool to its ceiling through its ``boost`` hook."""
+    _WARM.add(warm)
 
 
 # ---------------------------------------------------------------------------
@@ -313,6 +331,81 @@ def _act_tx_queue_high(record: Dict[str, Any], dry_run: bool):
                   "earlier"), revert
 
 
+def _act_slo_burn(record: Dict[str, Any], dry_run: bool):
+    """slo_burn: a tenant's serve-tier objective is burning budget
+    (telemetry/slo.py). Two existing levers, both reverted on clear:
+
+    * **warm-pool boost** — queue-wait and latency burn are usually
+      capacity-shaped, so pin every registered warm pool at its
+      ceiling (the floor is raised; the idle scale-down stops) until
+      the burn clears;
+    * **offender throttle** — an *error* burn is usually one tenant's
+      own failing workload crowding the pool, so cut the WDRR weight
+      of every in-flight map billed to the offending tenant (the
+      budget_exceeded lever, tenant-wide instead of per-key)."""
+    tenant = str(record.get("tenant") or "")
+    sli = str(record.get("sli") or "")
+    warms = [w for w in list(_WARM)]
+    if dry_run:
+        return False, (f"would boost {len(warms)} warm pool(s) to "
+                       f"ceiling"
+                       + (f" and throttle tenant {tenant!r}"
+                          if sli == "error" and tenant else "")), None
+    boosted = []
+    for warm in warms:
+        try:
+            if warm.boost():
+                boosted.append(weakref.ref(warm))
+        except Exception:  # noqa: BLE001 - one pool must not stop the rest
+            logger.exception("policy: warm-pool boost failed")
+    throttled: List[Tuple["weakref.ref", tuple]] = []
+    n_throttled = 0
+    if sli == "error" and tenant:
+        for pool in list(_POOLS):
+            try:
+                keys = {tuple(bk) for bk in
+                        list(pool._seq_bill.values())
+                        if bk and bk[0] == tenant}
+                for key in keys:
+                    hit = pool.throttle_billing_key(key, factor=4.0)
+                    if hit:
+                        n_throttled += hit
+                        throttled.append((weakref.ref(pool), key))
+            except Exception:  # noqa: BLE001
+                logger.exception("policy: slo_burn throttle failed")
+    parts = []
+    if boosted:
+        parts.append(f"boosted {len(boosted)} warm pool(s) to ceiling")
+    else:
+        parts.append("no warm pool to boost")
+    if n_throttled:
+        parts.append(f"throttled {n_throttled} in-flight map(s) of "
+                     f"tenant {tenant!r}: WDRR weight cut 4x")
+    elif sli == "error" and tenant:
+        parts.append(f"no in-flight map billed to tenant {tenant!r}")
+    applied = bool(boosted) or bool(n_throttled)
+    if not applied:
+        return False, "; ".join(parts), None
+
+    def revert() -> None:
+        for wref in boosted:
+            w = wref()
+            if w is not None:
+                try:
+                    w.unboost()
+                except Exception:  # noqa: BLE001 - best-effort restore
+                    pass
+        for pref, key in throttled:
+            p = pref()
+            if p is not None:
+                try:
+                    p.unthrottle_billing_key(key)
+                except Exception:  # noqa: BLE001 - best-effort restore
+                    pass
+
+    return True, "; ".join(parts), revert
+
+
 class Policy:
     """One rule -> action binding (declarative row of the engine)."""
 
@@ -347,6 +440,8 @@ _DEFAULT_POLICIES: Tuple[Policy, ...] = (
            knob="anomaly_tx_queue_mb"),
     Policy("queue_growth", "shrink_stream_window", _act_queue_growth,
            knob="stream_window"),
+    Policy("slo_burn", "boost_and_throttle", _act_slo_burn,
+           knob="serve_slo_burn"),
 )
 
 

@@ -1,0 +1,381 @@
+"""Per-tenant SLO plane for the serve daemon (docs/observability.md
+"SLOs and the archive").
+
+The serve tier already measures everything an objective needs — each
+job's ``submitted_at``/``started_at``/``finished_at`` stamps, terminal
+state and task count — but nothing turns those into the question a
+tenant actually asks: *is the service keeping its latency promise, and
+if not, how fast is it spending the error budget?* This plane is that
+turn:
+
+* **SLIs**, per tenant: queue-wait and submit→done latency as
+  fixed-bucket histograms (percentiles without unbounded storage),
+  task throughput, and the error/preemption rate.
+* **SLOs**: declarative targets from the ``serve_slo_*`` knobs — a
+  latency target bounds the ``serve_slo_p`` percentile; the error
+  objective's budget is ``serve_slo_error_pct``.
+* **Burn-rate evaluation**, multi-window: an objective's burn rate is
+  its bad-event fraction over a window divided by the budget fraction
+  (the SRE-workbook construction). ``slo_burn`` raises only when BOTH
+  the fast window (is it happening *now*?) and the slow window (is it
+  *significant*?) burn past ``serve_slo_burn`` — a single slow job
+  cannot page, and a long-finished incident cannot keep paging.
+
+``slo_burn`` rides :meth:`AnomalyWatchdog.external_breach`, so it is
+edge-triggered like every sampler rule and the policy plane maps it to
+remediations (warm-pool boost, offender throttle — telemetry/policy.py)
+with the same cause_id-linked anomaly → action → outcome chain.
+
+Durability: every observation is appended to the archive
+(``slo_obs`` records) the moment it is taken, and :meth:`replay`
+rebuilds windows + histograms from the archive tail — so a SIGKILLed
+daemon restarts with its burn state intact.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from fiber_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+#: Fixed latency histogram buckets, seconds (upper bounds; the last
+#: bucket is +inf). Chosen to resolve both interactive (ms) and batch
+#: (minutes) serve jobs without per-tenant tuning.
+BUCKETS: Tuple[float, ...] = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 30.0, 60.0, 120.0, 300.0)
+
+#: Job states that spend error budget (client cancel is the tenant's
+#: own choice, not a service failure).
+BAD_STATES = ("failed", "preempted")
+
+#: The aggregate pseudo-tenant every observation also lands under.
+ALL = "*"
+
+
+class _Hist:
+    """One fixed-bucket histogram: counts per bucket + overflow."""
+
+    __slots__ = ("counts", "n", "total")
+
+    def __init__(self) -> None:
+        self.counts = [0] * (len(BUCKETS) + 1)
+        self.n = 0
+        self.total = 0.0
+
+    def add(self, value: float) -> None:
+        i = 0
+        for i, bound in enumerate(BUCKETS):
+            if value <= bound:
+                break
+        else:
+            i = len(BUCKETS)
+        self.counts[i] += 1
+        self.n += 1
+        self.total += value
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Upper bound of the bucket holding the q-quantile (None when
+        empty; the overflow bucket reports the last finite bound — a
+        floor, honest for "p95 exceeds X")."""
+        if self.n <= 0:
+            return None
+        rank = q * self.n
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= rank and c:
+                return BUCKETS[min(i, len(BUCKETS) - 1)]
+        return BUCKETS[-1]
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"n": self.n, "mean": (self.total / self.n
+                                      if self.n else None),
+                "p50": self.quantile(0.50), "p90": self.quantile(0.90),
+                "p95": self.quantile(0.95), "p99": self.quantile(0.99)}
+
+
+class _Tenant:
+    """One tenant's SLI accumulators (lifetime histograms + counters;
+    the burn windows live in the tracker's shared observation ring)."""
+
+    __slots__ = ("queue", "latency", "states", "tasks")
+
+    def __init__(self) -> None:
+        self.queue = _Hist()
+        self.latency = _Hist()
+        self.states: Dict[str, int] = {}
+        self.tasks = 0
+
+
+class SloTracker:
+    """SLI accumulation + multi-window burn evaluation; owned by the
+    serve daemon's tick thread, read by RPC threads."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # targets (refreshed from config via configure())
+        self.latency_s = 0.0
+        self.queue_s = 0.0
+        self.p = 0.95
+        self.error_pct = 0.01
+        self.window_s = 3600.0
+        self.fast_window_s = 300.0
+        self.burn_threshold = 2.0
+        # state
+        self._tenants: Dict[str, _Tenant] = {}
+        self._obs: List[Dict[str, Any]] = []  # ring over window_s
+        self._seen: set = set()               # observed job ids
+        self._breached = False
+        self.observations = 0
+
+    def configure(self, cfg) -> None:
+        """Re-read the SLO knobs (telemetry.refresh)."""
+        self.latency_s = max(0.0, float(cfg.serve_slo_latency_s))
+        self.queue_s = max(0.0, float(cfg.serve_slo_queue_s))
+        self.p = min(0.999, max(0.5, float(cfg.serve_slo_p)))
+        self.error_pct = min(1.0, max(0.0001,
+                                      float(cfg.serve_slo_error_pct)))
+        self.window_s = max(1.0, float(cfg.serve_slo_window_s))
+        self.fast_window_s = min(
+            self.window_s, max(0.5, float(cfg.serve_slo_fast_window_s)))
+        self.burn_threshold = max(0.1, float(cfg.serve_slo_burn))
+
+    # -- observation ----------------------------------------------------
+    def observe(self, tenant: str, state: str,
+                queue_wait: Optional[float] = None,
+                latency: Optional[float] = None, tasks: int = 0,
+                job_id: Optional[str] = None, ts: Optional[float] = None,
+                archive: bool = True) -> None:
+        """Record one finished job. Called by the daemon tick for every
+        newly terminal job (and by replay with ``archive=False``)."""
+        ts = time.time() if ts is None else float(ts)
+        obs = {"tenant": tenant, "state": state,
+               "queue_wait": queue_wait, "latency": latency,
+               "tasks": int(tasks), "job_id": job_id, "ts": ts}
+        with self._lock:
+            if job_id is not None:
+                if job_id in self._seen:
+                    return
+                self._seen.add(job_id)
+            for name in (tenant, ALL):
+                t = self._tenants.get(name)
+                if t is None:
+                    t = self._tenants[name] = _Tenant()
+                if queue_wait is not None:
+                    t.queue.add(float(queue_wait))
+                if latency is not None:
+                    t.latency.add(float(latency))
+                t.states[state] = t.states.get(state, 0) + 1
+                t.tasks += int(tasks)
+            self._obs.append(obs)
+            self.observations += 1
+            self._trim_locked(ts)
+        if archive:
+            from fiber_tpu.telemetry.archive import ARCHIVE
+
+            ARCHIVE.append("slo_obs", dict(obs))
+
+    def observe_jobs(self, views: List[Dict[str, Any]]) -> int:
+        """Fold a batch of terminal job views (JobRunner dicts) into
+        the SLIs; returns how many were new."""
+        n = 0
+        for view in views:
+            job_id = view.get("job_id")
+            with self._lock:
+                if job_id in self._seen:
+                    continue
+            sub = view.get("submitted_at")
+            fin = view.get("finished_at")
+            start = view.get("started_at") or fin
+            latency = (float(fin) - float(sub)
+                       if sub is not None and fin is not None else None)
+            queue_wait = (float(start) - float(sub)
+                          if sub is not None and start is not None
+                          else None)
+            self.observe(str(view.get("tenant") or "default"),
+                         str(view.get("state") or ""),
+                         queue_wait=queue_wait, latency=latency,
+                         tasks=int(view.get("n_items") or 0),
+                         job_id=job_id, ts=fin)
+            n += 1
+        return n
+
+    def _trim_locked(self, now: float) -> None:
+        horizon = now - self.window_s
+        if self._obs and self._obs[0]["ts"] < horizon:
+            self._obs = [o for o in self._obs if o["ts"] >= horizon]
+
+    # -- burn evaluation ------------------------------------------------
+    def _objectives(self) -> List[Tuple[str, float]]:
+        """(name, budget fraction) of every armed objective."""
+        out = [("error", self.error_pct)]
+        if self.latency_s > 0:
+            out.append(("latency", 1.0 - self.p))
+        if self.queue_s > 0:
+            out.append(("queue", 1.0 - self.p))
+        return out
+
+    def _bad(self, obs: Dict[str, Any], objective: str) -> bool:
+        if objective == "error":
+            return obs["state"] in BAD_STATES
+        if objective == "latency":
+            return (obs["latency"] is not None
+                    and obs["latency"] > self.latency_s)
+        return (obs["queue_wait"] is not None
+                and obs["queue_wait"] > self.queue_s)
+
+    def burn_rates(self, now: Optional[float] = None
+                   ) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant burn state: for each armed objective, the fast-
+        and slow-window burn rates (bad fraction / budget fraction)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            obs = list(self._obs)
+        slow = [o for o in obs if o["ts"] >= now - self.window_s]
+        fast = [o for o in slow if o["ts"] >= now - self.fast_window_s]
+        tenants = sorted({o["tenant"] for o in slow} - {ALL}) + [ALL]
+        out: Dict[str, Dict[str, Any]] = {}
+        for tenant in tenants:
+            t_slow = (slow if tenant == ALL
+                      else [o for o in slow if o["tenant"] == tenant])
+            t_fast = (fast if tenant == ALL
+                      else [o for o in fast if o["tenant"] == tenant])
+            objs = {}
+            for name, budget in self._objectives():
+                objs[name] = {
+                    "budget": budget,
+                    "burn_fast": self._burn(t_fast, name, budget),
+                    "burn_slow": self._burn(t_slow, name, budget),
+                }
+            out[tenant] = objs
+        return out
+
+    def _burn(self, obs: List[Dict[str, Any]], objective: str,
+              budget: float) -> Optional[float]:
+        if not obs:
+            return None
+        bad = sum(1 for o in obs if self._bad(o, objective))
+        return (bad / len(obs)) / budget
+
+    def evaluate(self, now: Optional[float] = None) -> Optional[Dict]:
+        """One burn-rate sweep (daemon tick): raise / refresh / clear
+        the edge-triggered ``slo_burn`` watchdog rule. Returns the
+        worst offender (or None). The refresh path keeps the anomaly
+        record's ``burn`` attr current, so the policy engine's outcome
+        verification sees real movement."""
+        from fiber_tpu.telemetry.monitor import WATCHDOG
+
+        now = time.time() if now is None else now
+        worst: Optional[Dict[str, Any]] = None
+        for tenant, objs in self.burn_rates(now).items():
+            if tenant == ALL:
+                continue  # the offender is always a real tenant
+            for name, b in objs.items():
+                bf, bs = b["burn_fast"], b["burn_slow"]
+                if bf is None or bs is None:
+                    continue
+                if bf < self.burn_threshold or bs < self.burn_threshold:
+                    continue
+                score = min(bf, bs)
+                if worst is None or score > worst["burn"]:
+                    worst = {"tenant": tenant, "sli": name,
+                             "burn": round(score, 2),
+                             "burn_fast": round(bf, 2),
+                             "burn_slow": round(bs, 2)}
+        if worst is not None:
+            self._breached = True
+            WATCHDOG.external_breach(
+                "slo_burn",
+                (f"tenant {worst['tenant']!r} {worst['sli']} SLO "
+                 f"burning {worst['burn']:g}x its budget "
+                 f"(fast {worst['burn_fast']:g}x / "
+                 f"slow {worst['burn_slow']:g}x "
+                 f">= {self.burn_threshold:g}x)"),
+                **worst)
+        elif self._breached:
+            self._breached = False
+            WATCHDOG.external_clear("slo_burn")
+        return worst
+
+    # -- restart replay -------------------------------------------------
+    def replay(self, now: Optional[float] = None) -> int:
+        """Rebuild windows/histograms/seen-set from the archive tail
+        (daemon startup, after a crash or SIGKILL). Returns how many
+        observations were restored."""
+        from fiber_tpu.telemetry.archive import ARCHIVE
+
+        now = time.time() if now is None else now
+        restored = 0
+        for rec in ARCHIVE.query("slo_obs", since=now - self.window_s):
+            try:
+                self.observe(str(rec.get("tenant") or "default"),
+                             str(rec.get("state") or ""),
+                             queue_wait=rec.get("queue_wait"),
+                             latency=rec.get("latency"),
+                             tasks=int(rec.get("tasks") or 0),
+                             job_id=rec.get("job_id"),
+                             ts=rec.get("ts"), archive=False)
+                restored += 1
+            except (TypeError, ValueError):
+                continue
+        if restored:
+            logger.info("slo: replayed %d observation(s) from the "
+                        "archive tail", restored)
+        return restored
+
+    # -- read side ------------------------------------------------------
+    def snapshot(self, tenant: Optional[str] = None) -> Dict[str, Any]:
+        """The ``fiber-tpu slo`` payload: per-tenant SLIs + burn state
+        + the targets they are judged against."""
+        burns = self.burn_rates()
+        with self._lock:
+            names = sorted(self._tenants)
+            if tenant is not None:
+                names = [n for n in names if n == tenant]
+            tenants = {}
+            for name in names:
+                t = self._tenants[name]
+                bad = sum(t.states.get(s, 0) for s in BAD_STATES)
+                total = sum(t.states.values())
+                tenants[name] = {
+                    "jobs": dict(t.states),
+                    "tasks": t.tasks,
+                    "error_rate": (bad / total) if total else 0.0,
+                    "queue_wait": t.queue.snapshot(),
+                    "latency": t.latency.snapshot(),
+                    "burn": burns.get(name, {}),
+                }
+            return {
+                "targets": {
+                    "latency_s": self.latency_s or None,
+                    "queue_s": self.queue_s or None,
+                    "p": self.p,
+                    "error_pct": self.error_pct,
+                    "window_s": self.window_s,
+                    "fast_window_s": self.fast_window_s,
+                    "burn_threshold": self.burn_threshold,
+                },
+                "tenants": tenants,
+                "breached": self._breached,
+                "observations": self.observations,
+                "window_jobs": len(self._obs),
+            }
+
+    def clear(self) -> None:
+        """Test isolation."""
+        with self._lock:
+            self._tenants.clear()
+            self._obs = []
+            self._seen.clear()
+            self._breached = False
+            self.observations = 0
+
+
+#: Process-wide tracker; configured by telemetry.refresh(), driven by
+#: the serve daemon's tick thread.
+SLO = SloTracker()

@@ -68,6 +68,10 @@ DIRECTIONS: Dict[str, str] = {
     # serving daemon (bench-serve): tenant fairness and warm-start
     # latency must not drift, lost tasks must stay at 0
     "serve_gates": "special",
+    # SLO plane + archive (bench-slo): armed-vs-plain overhead must
+    # stay flat, chaos-to-breach detection must not slow down, torn
+    # archive reads must stay at 0
+    "slo_gates": "special",
 }
 
 #: "special" metrics gate named RATIO FIELDS instead of "value"
@@ -88,6 +92,9 @@ RATIO_FIELDS: Dict[str, List[Tuple[str, str]]] = {
     "serve_gates": [("fairness_ratio", "lower"),
                     ("warm_latency_ratio", "lower"),
                     ("lost_tasks", "lower")],
+    "slo_gates": [("overhead", "lower"),
+                  ("burn_detect_s", "lower"),
+                  ("torn_reads", "lower")],
 }
 
 

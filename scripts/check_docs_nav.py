@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Lint guard: every docs page must be reachable from the mkdocs nav.
+
+A ``docs/*.md`` file absent from ``mkdocs.yml``'s ``nav:`` tree is a
+page nobody can navigate to — it builds, it renders, and it silently
+rots because no reader ever lands on it. The repo grows a docs page
+with nearly every subsystem PR, so the lint gate (``make lint``) fails
+the build until the page is either added to the nav or deleted.
+
+The check is deliberately dependency-free: rather than importing yaml
+(not a baked-in dependency), it scans ``mkdocs.yml`` for ``*.md``
+path tokens — any mention anywhere in the file counts as "in the
+nav", which errs on the permissive side but catches the real failure
+mode (a brand-new page never wired in at all).
+
+Usage: ``python scripts/check_docs_nav.py [repo-root]`` (default
+``.``). Exit 0 when every page is reachable, 1 with a listing when
+orphans exist.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List, Set
+
+#: .md path tokens inside mkdocs.yml (nav entries look like
+#: ``- Observability: observability.md`` — paths are docs-relative).
+_MD_RE = re.compile(r"([A-Za-z0-9._/-]+\.md)\b")
+
+
+def nav_pages(mkdocs_yml: str) -> Set[str]:
+    with open(mkdocs_yml, "r", encoding="utf-8") as fh:
+        return set(_MD_RE.findall(fh.read()))
+
+
+def docs_pages(docs_dir: str) -> List[str]:
+    pages: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(docs_dir):
+        dirnames[:] = [d for d in dirnames if not d.startswith(".")]
+        for name in filenames:
+            if name.endswith(".md"):
+                full = os.path.join(dirpath, name)
+                pages.append(os.path.relpath(full, docs_dir))
+    return sorted(pages)
+
+
+def main(argv=None) -> int:
+    args = list(argv if argv is not None else sys.argv[1:])
+    root = args[0] if args else "."
+    mkdocs_yml = os.path.join(root, "mkdocs.yml")
+    docs_dir = os.path.join(root, "docs")
+    if not os.path.exists(mkdocs_yml) or not os.path.isdir(docs_dir):
+        print(f"error: {mkdocs_yml} or {docs_dir} missing",
+              file=sys.stderr)
+        return 1
+    listed = nav_pages(mkdocs_yml)
+    missing = [p for p in docs_pages(docs_dir) if p not in listed]
+    if missing:
+        print("docs pages missing from mkdocs.yml nav:", file=sys.stderr)
+        for page in missing:
+            print(f"  docs/{page}", file=sys.stderr)
+        print("add them to the nav: tree (or delete the orphans).",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
